@@ -1,0 +1,28 @@
+"""lightgbm_tpu: a TPU-native gradient boosting framework.
+
+A from-scratch JAX/XLA/Pallas re-design of LightGBM (reference:
+/root/reference, v2.0-era): binned leaf-wise histogram GBDT with
+LightGBM-compatible parameters, model text format, and Python API —
+histograms on the MXU, split scans on the VPU, distributed learners as
+XLA collectives over a device mesh.
+"""
+
+__version__ = "0.1.0"
+
+from .config import Config, config_from_params, PARAM_ALIASES
+from .dataset import Dataset as RawDataset, Metadata
+from .tree import Tree
+from .boosting.gbdt import GBDT, create_boosting
+from .basic import Dataset, Booster, LightGBMError
+from .engine import train, cv
+from .callback import (early_stopping, print_evaluation, record_evaluation,
+                       reset_parameter)
+from .sklearn import LGBMModel, LGBMRegressor, LGBMClassifier, LGBMRanker
+
+__all__ = [
+    "Config", "config_from_params", "PARAM_ALIASES", "Metadata", "Tree",
+    "GBDT", "create_boosting", "Dataset", "Booster", "LightGBMError",
+    "train", "cv", "early_stopping", "print_evaluation", "record_evaluation",
+    "reset_parameter", "LGBMModel", "LGBMRegressor", "LGBMClassifier",
+    "LGBMRanker",
+]

@@ -1,0 +1,381 @@
+"""User-facing Dataset / Booster API.
+
+Mirrors the reference python package (/root/reference/python-package/
+lightgbm/basic.py): `Dataset` with lazy construction, reference-alignment
+for validation data, pandas & categorical handling (basic.py:536-1159);
+`Booster` with update/eval/predict/save (basic.py:1160-1781).  There is no
+ctypes/C-API hop: the "engine" underneath is the in-process JAX GBDT.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .config import Config, config_from_params
+from .dataset import Dataset as _InnerDataset, Metadata
+from .boosting.gbdt import GBDT, create_boosting
+
+
+class LightGBMError(Exception):
+    """Error raised by this package (reference basic.py LightGBMError)."""
+
+
+def _to_numpy(data) -> np.ndarray:
+    if hasattr(data, "values"):  # pandas DataFrame/Series
+        return np.asarray(data.values, dtype=np.float64)
+    if isinstance(data, (list, tuple)):
+        return np.asarray(data, dtype=np.float64)
+    if hasattr(data, "toarray"):  # scipy sparse
+        return np.asarray(data.toarray(), dtype=np.float64)
+    return np.asarray(data, dtype=np.float64)
+
+
+def _resolve_categorical(data, categorical_feature, feature_name):
+    """pandas categorical columns -> codes + column index list
+    (reference basic.py:192-260 pandas handling)."""
+    cat_cols: List[int] = []
+    pandas_categorical = None
+    if hasattr(data, "dtypes") and hasattr(data, "columns"):
+        import pandas as pd  # type: ignore
+        df = data.copy()
+        pandas_categorical = []
+        for i, col in enumerate(df.columns):
+            if str(df[col].dtype) == "category":
+                pandas_categorical.append(list(df[col].cat.categories))
+                df[col] = df[col].cat.codes.astype(np.float64)
+                cat_cols.append(i)
+        data = df
+    if categorical_feature not in (None, "auto"):
+        names = feature_name if feature_name not in (None, "auto") else None
+        for c in categorical_feature:
+            if isinstance(c, str) and names:
+                cat_cols.append(names.index(c))
+            elif isinstance(c, int):
+                cat_cols.append(c)
+    return data, sorted(set(cat_cols)), pandas_categorical
+
+
+class Dataset:
+    """Training/validation dataset with lazy construction."""
+
+    def __init__(self, data, label=None, max_bin=None, reference=None,
+                 weight=None, group=None, init_score=None, silent=False,
+                 feature_name="auto", categorical_feature="auto", params=None,
+                 free_raw_data=False):
+        self.params: Dict[str, Any] = dict(params or {})
+        if max_bin is not None:
+            self.params.setdefault("max_bin", max_bin)
+        self.data = data
+        self.label = label
+        self.reference = reference
+        self.weight = weight
+        self.group = group
+        self.init_score = init_score
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.free_raw_data = free_raw_data
+        self.pandas_categorical = None
+        self._inner: Optional[_InnerDataset] = None
+        self._raw_X: Optional[np.ndarray] = None
+
+    # -- construction -------------------------------------------------------
+
+    def construct(self, extra_params: Optional[Dict[str, Any]] = None
+                  ) -> "Dataset":
+        if self._inner is not None:
+            return self
+        merged = dict(self.params)
+        if extra_params:
+            for k, v in extra_params.items():
+                merged.setdefault(k, v)
+        cfg = config_from_params(merged)
+        if isinstance(self.data, str):
+            ref_inner = (self.reference.construct()._inner
+                         if self.reference is not None else None)
+            self._inner = _InnerDataset.from_file(self.data, cfg,
+                                                  reference=ref_inner)
+            self._raw_X = None
+        else:
+            data, cat_cols, self.pandas_categorical = _resolve_categorical(
+                self.data, self.categorical_feature, self.feature_name)
+            X = _to_numpy(data)
+            if X.ndim == 1:
+                X = X.reshape(-1, 1)
+            y = None if self.label is None else _to_numpy(self.label).reshape(-1)
+            md = Metadata()
+            if self.weight is not None:
+                md.weights = _to_numpy(self.weight).reshape(-1).astype(np.float32)
+            if self.group is not None:
+                md.set_query_from_sizes(_to_numpy(self.group).reshape(-1)
+                                        .astype(np.int64))
+            if self.init_score is not None:
+                md.init_score = _to_numpy(self.init_score).reshape(-1)
+            names = None
+            if self.feature_name not in (None, "auto"):
+                names = list(self.feature_name)
+            elif hasattr(self.data, "columns"):
+                names = [str(c) for c in self.data.columns]
+            ref_inner = (self.reference.construct()._inner
+                         if self.reference is not None else None)
+            self._inner = _InnerDataset(
+                X, y, cfg, reference=ref_inner, metadata=md,
+                feature_names=names, categorical_feature=cat_cols)
+            self._raw_X = X if not self.free_raw_data else None
+        return self
+
+    # -- reference-style helpers -------------------------------------------
+
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, silent=False, params=None) -> "Dataset":
+        return Dataset(data, label=label, reference=self, weight=weight,
+                       group=group, init_score=init_score, silent=silent,
+                       params=params or self.params)
+
+    def set_label(self, label) -> None:
+        self.label = label
+        if self._inner is not None:
+            self._inner.metadata.label = _to_numpy(label).astype(np.float32)
+
+    def set_weight(self, weight) -> None:
+        self.weight = weight
+        if self._inner is not None:
+            self._inner.metadata.weights = (
+                None if weight is None
+                else _to_numpy(weight).reshape(-1).astype(np.float32))
+
+    def set_group(self, group) -> None:
+        self.group = group
+        if self._inner is not None:
+            self._inner.metadata.set_query_from_sizes(
+                _to_numpy(group).reshape(-1).astype(np.int64))
+
+    def set_init_score(self, init_score) -> None:
+        self.init_score = init_score
+        if self._inner is not None:
+            self._inner.metadata.init_score = (
+                None if init_score is None
+                else _to_numpy(init_score).reshape(-1))
+
+    def get_label(self):
+        self.construct()
+        return np.asarray(self._inner.metadata.label)
+
+    def get_weight(self):
+        self.construct()
+        return self._inner.metadata.weights
+
+    def get_group(self):
+        self.construct()
+        qb = self._inner.metadata.query_boundaries
+        return None if qb is None else np.diff(qb)
+
+    def get_init_score(self):
+        self.construct()
+        return self._inner.metadata.init_score
+
+    def num_data(self) -> int:
+        self.construct()
+        return self._inner.num_data
+
+    def num_feature(self) -> int:
+        self.construct()
+        return self._inner.num_total_features
+
+    def get_feature_name(self) -> List[str]:
+        self.construct()
+        return list(self._inner.feature_names)
+
+    def subset(self, used_indices: Sequence[int], params=None) -> "Dataset":
+        """Row-subset dataset (reference Dataset.subset) — used by cv()."""
+        self.construct()
+        idx = np.asarray(used_indices, np.int64)
+        if self._raw_X is None and not isinstance(self.data, str):
+            raise LightGBMError("cannot subset when raw data was freed")
+        if isinstance(self.data, str):
+            raise LightGBMError("subset of file-backed Dataset not supported")
+        sub = Dataset(self._raw_X[idx],
+                      label=np.asarray(self.get_label())[idx],
+                      reference=self, params=params or self.params)
+        w = self.get_weight()
+        if w is not None:
+            sub.weight = np.asarray(w)[idx]
+        return sub
+
+
+class Booster:
+    """The boosting model driver (reference basic.py:1160+)."""
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None, silent: bool = False):
+        params = dict(params or {})
+        self.params = params
+        self.best_iteration = -1
+        self.best_score: Dict = {}
+        self._valid_names: List[str] = []
+        if train_set is not None:
+            if not isinstance(train_set, Dataset):
+                raise TypeError("train_set should be Dataset instance")
+            train_set.construct(params)
+            cfg = config_from_params(params)
+            self._gbdt = create_boosting(cfg)
+            self._gbdt.reset_training_data(train_set._inner)
+            self.train_set = train_set
+        elif model_file is not None:
+            with open(model_file) as f:
+                s = f.read()
+            cfg = config_from_params(params)
+            self._gbdt = create_boosting(cfg, model_file)
+            self._gbdt.load_model_from_string(s)
+            self.train_set = None
+        elif model_str is not None:
+            cfg = config_from_params(params)
+            self._gbdt = GBDT(cfg)
+            self._gbdt.load_model_from_string(model_str)
+            self.train_set = None
+        else:
+            raise TypeError("need at least one of train_set, model_file, model_str")
+
+    # -- training -----------------------------------------------------------
+
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        data.construct(self.params)
+        self._gbdt.add_valid(data._inner, name)
+        self._valid_names.append(name)
+        return self
+
+    def update(self, train_set: Optional[Dataset] = None,
+               fobj: Optional[Callable] = None) -> bool:
+        """One boosting iteration; returns True if no further splits."""
+        if train_set is not None and train_set is not self.train_set:
+            train_set.construct(self.params)
+            self._gbdt.reset_training_data(train_set._inner)
+            self.train_set = train_set
+        if fobj is None:
+            return self._gbdt.train_one_iter(None, None, False)
+        preds = self.__inner_raw_score()
+        grad, hess = fobj(preds, self.train_set)
+        return self.__boost(grad, hess)
+
+    def __inner_raw_score(self) -> np.ndarray:
+        sc = self._gbdt.train_score.get()
+        return sc.reshape(-1)  # class-major flat, like the reference
+
+    def __boost(self, grad, hess) -> bool:
+        import jax.numpy as jnp
+        K = self._gbdt.K
+        n = self._gbdt.num_data
+        g = np.asarray(grad, np.float32).reshape(K, n)
+        h = np.asarray(hess, np.float32).reshape(K, n)
+        return self._gbdt.train_one_iter(jnp.asarray(g), jnp.asarray(h), False)
+
+    def rollback_one_iter(self) -> "Booster":
+        self._gbdt.rollback_one_iter()
+        return self
+
+    def current_iteration(self) -> int:
+        return self._gbdt.current_iteration()
+
+    def num_trees(self) -> int:
+        return self._gbdt.num_trees
+
+    def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
+        self.params.update(params)
+        new_cfg = config_from_params(self.params)
+        self._gbdt.config = new_cfg
+        self._gbdt.shrinkage_rate = new_cfg.learning_rate
+        if self._gbdt.train_set is not None:
+            self._gbdt.learner.config = new_cfg
+        return self
+
+    # -- evaluation ---------------------------------------------------------
+
+    def eval_train(self, feval=None):
+        return self.__eval("training", self._gbdt.eval_train(), feval,
+                           is_train=True)
+
+    def eval_valid(self, feval=None):
+        return self.__eval(None, self._gbdt.eval_valid(), feval,
+                           is_train=False)
+
+    def eval(self, data: Dataset, name: str, feval=None):
+        if data is self.train_set:
+            return self.eval_train(feval)
+        return [r for r in self.eval_valid(feval) if r[0] == name]
+
+    def __eval(self, name, results, feval, is_train):
+        out = [(nm, metric, val, hib) for nm, metric, val, hib in results]
+        if feval is not None:
+            if is_train and self.train_set is not None:
+                ret = feval(self.__inner_raw_score(), self.train_set)
+                if ret is not None:
+                    if isinstance(ret, tuple):
+                        ret = [ret]
+                    for fname, val, hib in ret:
+                        out.append(("training", fname, val, hib))
+        return out
+
+    # -- prediction ---------------------------------------------------------
+
+    def predict(self, data, num_iteration: int = -1, raw_score: bool = False,
+                pred_leaf: bool = False, data_has_header: bool = False,
+                is_reshape: bool = True) -> np.ndarray:
+        if isinstance(data, str):
+            from .dataset import parse_text_file
+            X, _, _ = parse_text_file(data, data_has_header)
+        else:
+            X = _to_numpy(data)
+            if X.ndim == 1:
+                X = X.reshape(1, -1)
+        if pred_leaf:
+            return self._gbdt.predict_leaf_index(X, num_iteration)
+        if raw_score:
+            return self._gbdt.predict_raw(X, num_iteration)
+        return self._gbdt.predict(X, num_iteration)
+
+    # -- model io -----------------------------------------------------------
+
+    def save_model(self, filename: str, num_iteration: int = -1) -> "Booster":
+        self._gbdt.save_model_to_file(filename, num_iteration)
+        return self
+
+    def model_to_string(self, num_iteration: int = -1) -> str:
+        return self._gbdt.save_model_to_string(num_iteration)
+
+    def dump_model(self, num_iteration: int = -1) -> Dict:
+        return self._gbdt.to_json()
+
+    def feature_importance(self, importance_type: str = "split") -> np.ndarray:
+        imp = self._gbdt.feature_importance()
+        names = self.feature_name()
+        return np.array([imp.get(n, 0) for n in names], np.int64)
+
+    def feature_name(self) -> List[str]:
+        return list(self._gbdt.feature_names)
+
+    def num_feature(self) -> int:
+        return self._gbdt.max_feature_idx + 1
+
+    def free_dataset(self) -> "Booster":
+        self.train_set = None
+        return self
+
+    def __getstate__(self):
+        state = {"params": self.params,
+                 "model_str": self.model_to_string(),
+                 "best_iteration": self.best_iteration,
+                 "best_score": self.best_score}
+        return state
+
+    def __setstate__(self, state):
+        self.params = state["params"]
+        cfg = config_from_params(self.params)
+        self._gbdt = GBDT(cfg)
+        self._gbdt.load_model_from_string(state["model_str"])
+        self.best_iteration = state.get("best_iteration", -1)
+        self.best_score = state.get("best_score", {})
+        self.train_set = None
+        self._valid_names = []

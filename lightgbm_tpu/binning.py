@@ -1,0 +1,255 @@
+"""Host-side feature binning (BinMapper).
+
+Behavioral parity with the reference's BinMapper::FindBin
+(/root/reference/src/io/bin.cpp:67-240):
+
+- numerical features: distinct-value bins when few distinct values, else
+  greedy count-balanced boundaries with "big count" values pinned to their
+  own bin; zero is injected as a distinct value with the implied zero count;
+  `min_data_in_bin` merging; last upper bound is +inf.
+- categorical features: categories sorted by frequency, kept until covering
+  98% of samples (and at least max_bin categories when available).
+- trivial-feature filtering (NeedFilter, bin.cpp:47-65).
+
+The output is a plain-python BinMapper per feature; the device-side Dataset
+packs `value -> bin` results into a [num_features, num_rows] integer array
+(see dataset.py).  This replaces the reference's Bin/DenseBin/SparseBin
+class zoo: on TPU everything is one dense HBM-resident array.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+NUMERICAL = 0
+CATEGORICAL = 1
+
+
+@dataclass
+class BinMapper:
+    bin_type: int = NUMERICAL
+    num_bin: int = 1
+    is_trivial: bool = True
+    # numerical
+    bin_upper_bound: np.ndarray = field(default_factory=lambda: np.array([np.inf]))
+    # categorical
+    bin_2_categorical: List[int] = field(default_factory=list)
+    categorical_2_bin: Dict[int, int] = field(default_factory=dict)
+    min_val: float = 0.0
+    max_val: float = 0.0
+    default_bin: int = 0
+    sparse_rate: float = 0.0
+
+    def value_to_bin(self, values: np.ndarray) -> np.ndarray:
+        """Vectorized value->bin (reference bin.h:418-440)."""
+        values = np.asarray(values, dtype=np.float64)
+        if self.bin_type == NUMERICAL:
+            return np.searchsorted(self.bin_upper_bound, values, side="left").astype(
+                np.int32)
+        out = np.zeros(values.shape, dtype=np.int32)
+        iv = values.astype(np.int64)
+        for cat, b in self.categorical_2_bin.items():
+            out[iv == cat] = b
+        return out
+
+    def bin_to_value(self, b: int) -> float:
+        """Real-valued threshold stored in the model text for bin `b`."""
+        if self.bin_type == NUMERICAL:
+            return float(self.bin_upper_bound[min(b, self.num_bin - 1)])
+        return float(self.bin_2_categorical[min(b, len(self.bin_2_categorical) - 1)])
+
+    def feature_info(self) -> str:
+        """`feature_infos` model-header entry (gbdt.cpp:715: [min:max] or cat list)."""
+        if self.is_trivial:
+            return "none"
+        if self.bin_type == NUMERICAL:
+            return f"[{self.min_val:g}:{self.max_val:g}]"
+        return ":".join(str(c) for c in self.bin_2_categorical)
+
+
+def _distinct_with_zero(sample_values: np.ndarray, total_sample_cnt: int
+                        ) -> Tuple[np.ndarray, np.ndarray]:
+    """Distinct values + counts with zero injected at the right rank.
+
+    `sample_values` are the NON-ZERO sampled values; zeros are implied
+    (reference bin.cpp:70-103 treats zero_cnt = total - num_sampled).
+    """
+    sample_values = np.asarray(sample_values, dtype=np.float64)
+    sample_values = sample_values[~np.isnan(sample_values)]
+    zero_cnt = int(total_sample_cnt - sample_values.size)
+    if sample_values.size == 0:
+        return np.array([0.0]), np.array([max(zero_cnt, 1)], dtype=np.int64)
+    vals, counts = np.unique(sample_values, return_counts=True)
+    if zero_cnt > 0 and not np.any(vals == 0.0):
+        pos = int(np.searchsorted(vals, 0.0))
+        vals = np.insert(vals, pos, 0.0)
+        counts = np.insert(counts, pos, zero_cnt)
+    elif zero_cnt > 0:
+        counts[vals == 0.0] += zero_cnt
+    return vals, counts.astype(np.int64)
+
+
+def _numerical_bins(vals: np.ndarray, counts: np.ndarray, total_sample_cnt: int,
+                    max_bin: int, min_data_in_bin: int) -> Tuple[np.ndarray, List[int]]:
+    """Greedy count-balanced boundaries (reference bin.cpp:109-186)."""
+    n_distinct = vals.size
+    cnt_in_bin: List[int] = []
+    if n_distinct <= max_bin:
+        ub: List[float] = []
+        cur = 0
+        for i in range(n_distinct - 1):
+            cur += int(counts[i])
+            if cur >= min_data_in_bin:
+                ub.append((vals[i] + vals[i + 1]) / 2.0)
+                cnt_in_bin.append(cur)
+                cur = 0
+        cur += int(counts[-1])
+        cnt_in_bin.append(cur)
+        ub.append(np.inf)
+        return np.array(ub), cnt_in_bin
+
+    if min_data_in_bin > 0:
+        max_bin = max(1, min(max_bin, total_sample_cnt // min_data_in_bin))
+    mean_bin_size = total_sample_cnt / max_bin
+    zero_idx = np.flatnonzero(vals == 0.0)
+    zero_cnt = int(counts[zero_idx[0]]) if zero_idx.size else 0
+    if zero_cnt > mean_bin_size:
+        non_zero_cnt = total_sample_cnt - zero_cnt
+        max_bin = min(max_bin, 1 + non_zero_cnt // max(min_data_in_bin, 1))
+    max_bin = max(int(max_bin), 1)
+
+    is_big = counts >= mean_bin_size
+    rest_bin_cnt = max_bin - int(is_big.sum())
+    rest_sample_cnt = total_sample_cnt - int(counts[is_big].sum())
+    if rest_bin_cnt > 0:
+        mean_bin_size = rest_sample_cnt / rest_bin_cnt
+
+    upper: List[float] = []
+    lower: List[float] = [float(vals[0])]
+    cur = 0
+    bin_cnt = 0
+    for i in range(n_distinct - 1):
+        if not is_big[i]:
+            rest_sample_cnt -= int(counts[i])
+        cur += int(counts[i])
+        if (is_big[i] or cur >= mean_bin_size or
+                (is_big[i + 1] and cur >= max(1.0, mean_bin_size * 0.5))):
+            upper.append(float(vals[i]))
+            cnt_in_bin.append(cur)
+            bin_cnt += 1
+            lower.append(float(vals[i + 1]))
+            if bin_cnt >= max_bin - 1:
+                break
+            cur = 0
+            if not is_big[i]:
+                rest_bin_cnt -= 1
+                if rest_bin_cnt > 0:
+                    mean_bin_size = rest_sample_cnt / rest_bin_cnt
+    # remaining samples go to the last bin
+    consumed = sum(cnt_in_bin)
+    cnt_in_bin.append(int(total_sample_cnt - consumed))
+    bin_cnt += 1
+    ub = np.empty(bin_cnt)
+    for i in range(bin_cnt - 1):
+        ub[i] = (upper[i] + lower[i + 1]) / 2.0
+    ub[bin_cnt - 1] = np.inf
+    return ub, cnt_in_bin
+
+
+def _need_filter(cnt_in_bin: Sequence[int], total_cnt: int, filter_cnt: int,
+                 bin_type: int) -> bool:
+    """A feature is trivial if no split leaves >= filter_cnt on both sides
+    (reference bin.cpp:47-65)."""
+    if bin_type == NUMERICAL:
+        sum_left = 0
+        for c in cnt_in_bin[:-1]:
+            sum_left += c
+            if sum_left >= filter_cnt and total_cnt - sum_left >= filter_cnt:
+                return False
+    else:
+        for c in cnt_in_bin[:-1]:
+            if c >= filter_cnt and total_cnt - c >= filter_cnt:
+                return False
+    return True
+
+
+def find_bin(sample_values: np.ndarray, total_sample_cnt: int, max_bin: int,
+             min_data_in_bin: int = 3, min_split_data: int = 20,
+             bin_type: int = NUMERICAL) -> BinMapper:
+    """Construct a BinMapper from sampled (non-zero) values of one feature.
+
+    Mirrors reference BinMapper::FindBin (bin.cpp:67-240).
+    """
+    m = BinMapper(bin_type=bin_type)
+    vals, counts = _distinct_with_zero(sample_values, total_sample_cnt)
+    m.min_val, m.max_val = float(vals[0]), float(vals[-1])
+
+    if bin_type == NUMERICAL:
+        ub, cnt_in_bin = _numerical_bins(vals, counts, total_sample_cnt, max_bin,
+                                         min_data_in_bin)
+        m.bin_upper_bound = ub
+        m.num_bin = int(ub.size)
+    else:
+        ivals = vals.astype(np.int64)
+        # merge duplicates after int cast
+        ivals_u, inv = np.unique(ivals, return_inverse=True)
+        icounts = np.zeros(ivals_u.size, dtype=np.int64)
+        np.add.at(icounts, inv, counts)
+        order = np.argsort(-icounts, kind="stable")
+        ivals_u, icounts = ivals_u[order], icounts[order]
+        cut_cnt = int(total_sample_cnt * 0.98)
+        eff_max_bin = min(ivals_u.size, max_bin)
+        used_cnt = 0
+        nb = 0
+        while (used_cnt < cut_cnt or nb < eff_max_bin) and nb < ivals_u.size:
+            m.bin_2_categorical.append(int(ivals_u[nb]))
+            m.categorical_2_bin[int(ivals_u[nb])] = nb
+            used_cnt += int(icounts[nb])
+            nb += 1
+        m.num_bin = nb
+        cnt_in_bin = [int(c) for c in icounts[:nb]]
+        cnt_in_bin[-1] += int(total_sample_cnt - used_cnt)
+
+    m.is_trivial = m.num_bin <= 1
+    if not m.is_trivial and _need_filter(cnt_in_bin, total_sample_cnt,
+                                         min_split_data, bin_type):
+        m.is_trivial = True
+    if not m.is_trivial:
+        m.default_bin = int(m.value_to_bin(np.array([0.0]))[0])
+        idx = min(m.default_bin, len(cnt_in_bin) - 1)
+        m.sparse_rate = cnt_in_bin[idx] / total_sample_cnt
+    return m
+
+
+def find_bin_mappers(X: np.ndarray, max_bin: int, min_data_in_bin: int,
+                     min_split_data: int, categorical: Sequence[int] = (),
+                     sample_cnt: int = 200000, seed: int = 1
+                     ) -> List[BinMapper]:
+    """Find bin mappers for all columns of a dense matrix.
+
+    Equivalent of DatasetLoader::ConstructBinMappersFromTextData
+    (dataset_loader.cpp:661-837) for in-memory data: sample up to
+    `sample_cnt` rows, then per-feature FindBin on the non-zero sampled
+    values.
+    """
+    n, f = X.shape
+    rng = np.random.RandomState(seed)
+    if n > sample_cnt:
+        idx = np.sort(rng.choice(n, size=sample_cnt, replace=False))
+        sample = X[idx]
+        total = sample_cnt
+    else:
+        sample = X
+        total = n
+    cats = set(int(c) for c in categorical)
+    mappers = []
+    for j in range(f):
+        col = np.asarray(sample[:, j], dtype=np.float64)
+        nonzero = col[(col != 0.0) & ~np.isnan(col)]
+        bt = CATEGORICAL if j in cats else NUMERICAL
+        mappers.append(find_bin(nonzero, total, max_bin, min_data_in_bin,
+                                min_split_data, bt))
+    return mappers

@@ -1,0 +1,4 @@
+from .gbdt import GBDT, create_boosting
+from .dart import DART
+from .goss import GOSS
+from .score_updater import ScoreUpdater

@@ -1,0 +1,123 @@
+"""DART boosting (Dropouts meet Multiple Additive Regression Trees).
+
+Parity with /root/reference/src/boosting/dart.hpp: per-iteration tree
+dropout — `_dropping_trees` selects the drop set (uniform or
+weight-proportional, dart.hpp:84-128) and removes their scores, the new
+tree trains against the modified gradients, then `_normalize` rescales the
+dropped trees by k/(k+1) (or xgboost mode) fixing train and valid scores
+separately (dart.hpp:139-178).
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ..config import Config
+from .gbdt import GBDT
+
+
+class DART(GBDT):
+    def __init__(self, config: Config, train_set=None, objective=None):
+        super().__init__(config, train_set, objective)
+        self.drop_rng = np.random.RandomState(config.drop_seed)
+        self.tree_weight: List[float] = []
+        self.sum_weight = 0.0
+        self.drop_index: List[int] = []
+
+    def sub_model_name(self) -> str:
+        return "dart"
+
+    def reset_training_data(self, train_set, objective=None):
+        super().reset_training_data(train_set, objective)
+        self.shrinkage_rate = self.config.learning_rate
+
+    def train_one_iter(self, gradient=None, hessian=None,
+                       is_eval: bool = False) -> bool:
+        # boost_from_average is disabled for DART in the reference (no
+        # BoostFromAverage path is taken because DART overrides TrainOneIter
+        # ordering); keep GBDT behavior minus the average tree.
+        self._dropping_trees()
+        stop = GBDT.train_one_iter(self, gradient, hessian, False)
+        if not stop:
+            self._normalize()
+            self.tree_weight.append(self.shrinkage_rate)
+            self.sum_weight += self.shrinkage_rate
+            if is_eval:
+                return self.eval_and_check_early_stopping()
+        return stop
+
+    def _boost_from_average(self):
+        return  # dart.hpp has no boost-from-average init tree
+
+    # ------------------------------------------------------------------
+    def _dropping_trees(self) -> None:
+        cfg = self.config
+        self.drop_index = []
+        is_skip = self.drop_rng.random_sample() < cfg.skip_drop
+        if not is_skip and self.iter_ > 0:
+            drop_rate = cfg.drop_rate
+            if not cfg.uniform_drop:
+                inv_avg_w = len(self.tree_weight) / max(self.sum_weight, 1e-30)
+                if cfg.max_drop > 0:
+                    drop_rate = min(drop_rate,
+                                    cfg.max_drop * inv_avg_w /
+                                    max(self.sum_weight, 1e-30))
+                for i in range(self.iter_):
+                    if (self.drop_rng.random_sample()
+                            < drop_rate * self.tree_weight[i] * inv_avg_w):
+                        self.drop_index.append(i)
+            else:
+                if cfg.max_drop > 0:
+                    drop_rate = min(drop_rate, cfg.max_drop / self.iter_)
+                for i in range(self.iter_):
+                    if self.drop_rng.random_sample() < drop_rate:
+                        self.drop_index.append(i)
+        # drop: negate each dropped tree and add to train score
+        for i in self.drop_index:
+            for k in range(self.K):
+                tree = self._model_at(i, k)
+                tree.apply_shrinkage(-1.0)
+                self.train_score.add_tree(tree, k)
+        k_drop = len(self.drop_index)
+        if not cfg.xgboost_dart_mode:
+            self.shrinkage_rate = cfg.learning_rate / (1.0 + k_drop)
+        else:
+            if k_drop == 0:
+                self.shrinkage_rate = cfg.learning_rate
+            else:
+                self.shrinkage_rate = (cfg.learning_rate /
+                                       (cfg.learning_rate + k_drop))
+
+    def _model_at(self, iteration: int, k: int):
+        off = 1 if self.boost_from_average_used else 0
+        return self.models[off + iteration * self.K + k]
+
+    def _normalize(self) -> None:
+        cfg = self.config
+        k = float(len(self.drop_index))
+        for i in self.drop_index:
+            for ci in range(self.K):
+                tree = self._model_at(i, ci)
+                if not cfg.xgboost_dart_mode:
+                    # valid scores get tree * (-1 + k/(k+1)) net = -1/(k+1)
+                    tree.apply_shrinkage(1.0 / (k + 1.0))
+                    for _, _, su, _ in self.valid_sets:
+                        su.add_tree(tree, ci)
+                    # train scores: from -1 state we already added; restore
+                    # +k/(k+1) net by adding tree shrunk by -k
+                    tree.apply_shrinkage(-k)
+                    self.train_score.add_tree(tree, ci)
+                else:
+                    tree.apply_shrinkage(self.shrinkage_rate)
+                    for _, _, su, _ in self.valid_sets:
+                        su.add_tree(tree, ci)
+                    tree.apply_shrinkage(-k / cfg.learning_rate)
+                    self.train_score.add_tree(tree, ci)
+            if not cfg.uniform_drop:
+                if not cfg.xgboost_dart_mode:
+                    self.sum_weight -= self.tree_weight[i] / (k + 1.0)
+                    self.tree_weight[i] *= k / (k + 1.0)
+                else:
+                    self.sum_weight -= self.tree_weight[i] / (k + cfg.learning_rate)
+                    self.tree_weight[i] *= k / (k + cfg.learning_rate)

@@ -1,0 +1,397 @@
+"""Configuration system.
+
+TPU-native re-design of the reference config layer
+(/root/reference/include/LightGBM/config.h:86-284 and src/io/config.cpp):
+a single flat dataclass of typed parameters with LightGBM-compatible names,
+defaults, and the full alias table (config.h:342-436).  Unlike the reference's
+struct-per-layer split (IOConfig/TreeConfig/BoostingConfig/...), one frozen
+dataclass is passed everywhere; jitted code receives it as a hashable static
+argument.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+# Alias table: parity with reference config.h:342-436 (ParameterAlias).
+PARAM_ALIASES: Dict[str, str] = {
+    "config": "config_file",
+    "nthread": "num_threads",
+    "random_seed": "seed",
+    "num_thread": "num_threads",
+    "boosting": "boosting_type",
+    "boost": "boosting_type",
+    "application": "objective",
+    "app": "objective",
+    "train_data": "data",
+    "train": "data",
+    "model_output": "output_model",
+    "model_out": "output_model",
+    "model_input": "input_model",
+    "model_in": "input_model",
+    "predict_result": "output_result",
+    "prediction_result": "output_result",
+    "valid": "valid_data",
+    "test_data": "valid_data",
+    "test": "valid_data",
+    "is_sparse": "is_enable_sparse",
+    "enable_sparse": "is_enable_sparse",
+    "pre_partition": "is_pre_partition",
+    "tranining_metric": "is_training_metric",  # (sic) kept for parity
+    "train_metric": "is_training_metric",
+    "ndcg_at": "ndcg_eval_at",
+    "eval_at": "ndcg_eval_at",
+    "min_data_per_leaf": "min_data_in_leaf",
+    "min_data": "min_data_in_leaf",
+    "min_child_samples": "min_data_in_leaf",
+    "min_sum_hessian_per_leaf": "min_sum_hessian_in_leaf",
+    "min_sum_hessian": "min_sum_hessian_in_leaf",
+    "min_hessian": "min_sum_hessian_in_leaf",
+    "min_child_weight": "min_sum_hessian_in_leaf",
+    "num_leaf": "num_leaves",
+    "sub_feature": "feature_fraction",
+    "colsample_bytree": "feature_fraction",
+    "num_iteration": "num_iterations",
+    "num_tree": "num_iterations",
+    "num_round": "num_iterations",
+    "num_trees": "num_iterations",
+    "num_rounds": "num_iterations",
+    "sub_row": "bagging_fraction",
+    "subsample": "bagging_fraction",
+    "subsample_freq": "bagging_freq",
+    "shrinkage_rate": "learning_rate",
+    "tree": "tree_learner",
+    "num_machine": "num_machines",
+    "local_port": "local_listen_port",
+    "two_round_loading": "use_two_round_loading",
+    "two_round": "use_two_round_loading",
+    "mlist": "machine_list_file",
+    "is_save_binary": "is_save_binary_file",
+    "save_binary": "is_save_binary_file",
+    "early_stopping_rounds": "early_stopping_round",
+    "early_stopping": "early_stopping_round",
+    "verbosity": "verbose",
+    "header": "has_header",
+    "label": "label_column",
+    "weight": "weight_column",
+    "group": "group_column",
+    "query": "group_column",
+    "query_column": "group_column",
+    "ignore_feature": "ignore_column",
+    "blacklist": "ignore_column",
+    "categorical_feature": "categorical_column",
+    "cat_column": "categorical_column",
+    "cat_feature": "categorical_column",
+    "predict_raw_score": "is_predict_raw_score",
+    "predict_leaf_index": "is_predict_leaf_index",
+    "raw_score": "is_predict_raw_score",
+    "leaf_index": "is_predict_leaf_index",
+    "min_split_gain": "min_gain_to_split",
+    "topk": "top_k",
+    "reg_alpha": "lambda_l1",
+    "reg_lambda": "lambda_l2",
+    "num_classes": "num_class",
+    "unbalanced_sets": "is_unbalance",
+    # extra alias of this package
+    "tree_learner_type": "tree_learner",
+}
+
+# objective name aliases (reference config.cpp GetObjectiveType handling)
+OBJECTIVE_ALIASES: Dict[str, str] = {
+    "mean_squared_error": "regression",
+    "mse": "regression",
+    "regression_l2": "regression",
+    "l2": "regression",
+    "mean_absolute_error": "regression_l1",
+    "mae": "regression_l1",
+    "l1": "regression_l1",
+    "softmax": "multiclass",
+}
+
+_TRUE = {"true", "1", "yes", "on", "+", "t"}
+_FALSE = {"false", "0", "no", "off", "-", "f"}
+
+
+def _parse_bool(v: Any) -> bool:
+    if isinstance(v, bool):
+        return v
+    s = str(v).strip().lower()
+    if s in _TRUE:
+        return True
+    if s in _FALSE:
+        return False
+    raise ValueError(f"cannot parse boolean value: {v!r}")
+
+
+def _parse_int_list(v: Any) -> Tuple[int, ...]:
+    if v is None:
+        return tuple()
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    s = str(v).strip()
+    if not s:
+        return tuple()
+    return tuple(int(x) for x in s.replace(",", " ").split())
+
+
+def _parse_str_list(v: Any) -> Tuple[str, ...]:
+    if v is None:
+        return tuple()
+    if isinstance(v, (list, tuple)):
+        return tuple(str(x) for x in v)
+    s = str(v).strip()
+    if not s:
+        return tuple()
+    return tuple(x for x in s.replace(",", " ").split())
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    """All training / IO / network parameters (LightGBM-compatible names).
+
+    Defaults match the reference (config.h:86-284).
+    """
+
+    # -- task / overall (config.h:256-284)
+    task: str = "train"
+    objective: str = "regression"
+    boosting_type: str = "gbdt"
+    num_iterations: int = 100
+    learning_rate: float = 0.1
+    num_class: int = 1
+    seed: int = 0
+    num_threads: int = 0
+    verbose: int = 1
+    device_type: str = "tpu"  # reference: cpu|gpu; here: tpu (cpu = jax-cpu)
+
+    # -- IO (config.h:86-137)
+    max_bin: int = 255
+    data_random_seed: int = 1
+    data: str = ""
+    output_model: str = "LightGBM_model.txt"
+    input_model: str = ""
+    output_result: str = "LightGBM_predict_result.txt"
+    valid_data: Tuple[str, ...] = tuple()
+    is_enable_sparse: bool = True
+    use_two_round_loading: bool = False
+    is_save_binary_file: bool = False
+    enable_load_from_binary_file: bool = True
+    is_predict_raw_score: bool = False
+    is_predict_leaf_index: bool = False
+    has_header: bool = False
+    label_column: str = ""
+    weight_column: str = ""
+    group_column: str = ""
+    ignore_column: str = ""
+    categorical_column: str = ""
+    is_pre_partition: bool = False
+    bin_construct_sample_cnt: int = 200000
+    sparse_threshold: float = 0.8
+    min_data_in_bin: int = 3
+    enable_bundle: bool = True
+    max_conflict_rate: float = 0.0
+
+    # -- objective params (config.h:140-174)
+    is_unbalance: bool = False
+    sigmoid: float = 1.0
+    huber_delta: float = 1.0
+    fair_c: float = 1.0
+    gaussian_eta: float = 1.0
+    poisson_max_delta_step: float = 0.7
+    scale_pos_weight: float = 1.0
+    max_position: int = 20
+    label_gain: Tuple[float, ...] = tuple()
+
+    # -- metric (config.h:160-174)
+    metric: Tuple[str, ...] = tuple()
+    metric_freq: int = 1
+    is_training_metric: bool = False
+    ndcg_eval_at: Tuple[int, ...] = (1, 2, 3, 4, 5)
+
+    # -- tree (config.h:177-207)
+    num_leaves: int = 31
+    max_depth: int = -1
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_gain_to_split: float = 0.0
+    feature_fraction: float = 1.0
+    feature_fraction_seed: int = 2
+    histogram_pool_size: float = -1.0
+    top_k: int = 20
+    # gpu params kept for config compatibility (ignored on tpu)
+    gpu_platform_id: int = -1
+    gpu_device_id: int = -1
+    gpu_use_dp: bool = False
+
+    # -- boosting (config.h:210-242)
+    bagging_fraction: float = 1.0
+    bagging_freq: int = 0
+    bagging_seed: int = 3
+    early_stopping_round: int = 0
+    drop_rate: float = 0.1
+    skip_drop: float = 0.5
+    max_drop: int = 50
+    uniform_drop: bool = False
+    xgboost_dart_mode: bool = False
+    drop_seed: int = 4
+    top_rate: float = 0.2
+    other_rate: float = 0.1
+    tree_learner: str = "serial"
+
+    # -- network (config.h:245-252)
+    num_machines: int = 1
+    local_listen_port: int = 12400
+    time_out: int = 120
+    machine_list_file: str = ""
+
+    # -- tpu-specific knobs (new in this framework)
+    hist_dtype: str = "float32"      # accumulation dtype for histograms
+    hist_input_dtype: str = "bfloat16"  # MXU input dtype for one-hot matmul
+    fused_tree: bool = False         # force fully-jitted tree builder
+    mesh_shape: Tuple[int, ...] = tuple()  # override device mesh
+    boost_from_average: bool = True
+
+    # prediction
+    num_iteration_predict: int = -1
+
+    # fields that are parsed but unused on TPU (accepted for compat)
+    config_file: str = ""
+    output_freq: int = 1
+
+    def n_classes_for_trees(self) -> int:
+        return self.num_class if self.objective == "multiclass" else max(
+            1, self.num_class if self.objective == "multiclassova" else 1)
+
+    @property
+    def num_tree_per_iteration(self) -> int:
+        if self.objective in ("multiclass", "multiclassova"):
+            return max(1, self.num_class)
+        return 1
+
+    def with_updates(self, **kw) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+
+_FIELD_TYPES = {f.name: f.type for f in dataclasses.fields(Config)}
+_TUPLE_INT_FIELDS = {"ndcg_eval_at", "mesh_shape"}
+_TUPLE_FLOAT_FIELDS = {"label_gain"}
+_TUPLE_STR_FIELDS = {"valid_data", "metric"}
+
+
+def apply_aliases(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Resolve aliases; explicit canonical keys win (reference config.h:426-434)."""
+    out: Dict[str, Any] = {}
+    aliased: Dict[str, Any] = {}
+    for k, v in params.items():
+        k2 = k.strip().lower()
+        if k2 in PARAM_ALIASES:
+            aliased[PARAM_ALIASES[k2]] = v
+        else:
+            out[k2] = v
+    for k, v in aliased.items():
+        out.setdefault(k, v)
+    return out
+
+
+def _coerce(name: str, value: Any) -> Any:
+    if name in _TUPLE_INT_FIELDS:
+        return _parse_int_list(value)
+    if name in _TUPLE_FLOAT_FIELDS:
+        if isinstance(value, (list, tuple)):
+            return tuple(float(x) for x in value)
+        s = str(value).strip()
+        return tuple(float(x) for x in s.replace(",", " ").split()) if s else tuple()
+    if name in _TUPLE_STR_FIELDS:
+        return _parse_str_list(value)
+    ftype = str(_FIELD_TYPES[name])
+    if "bool" in ftype:
+        return _parse_bool(value)
+    if "int" in ftype:
+        return int(float(str(value)))
+    if "float" in ftype:
+        return float(value)
+    return str(value)
+
+
+def config_from_params(params: Dict[str, Any], **overrides) -> Config:
+    """Build a Config from a LightGBM-style param dict (Python-API entry).
+
+    Unknown keys are ignored with a record in `Config` creation (reference
+    behavior: unknown params are silently dropped by ConfigBase::Set).
+    """
+    merged = dict(params or {})
+    merged.update(overrides)
+    resolved = apply_aliases(merged)
+    # objective aliases
+    if "objective" in resolved:
+        obj = str(resolved["objective"]).strip().lower()
+        resolved["objective"] = OBJECTIVE_ALIASES.get(obj, obj)
+    kwargs = {}
+    for k, v in resolved.items():
+        if k in _FIELD_TYPES:
+            kwargs[k] = _coerce(k, v)
+    cfg = Config(**kwargs)
+    check_param_conflict(cfg)
+    return cfg
+
+
+def check_param_conflict(cfg: Config) -> None:
+    """Sanity checks (reference src/io/config.cpp CheckParamConflict)."""
+    if cfg.num_leaves < 2:
+        raise ValueError("num_leaves must be >= 2")
+    if cfg.max_bin < 2:
+        raise ValueError("max_bin must be >= 2")
+    if not (0.0 < cfg.feature_fraction <= 1.0):
+        raise ValueError("feature_fraction must be in (0, 1]")
+    if not (0.0 < cfg.bagging_fraction <= 1.0):
+        raise ValueError("bagging_fraction must be in (0, 1]")
+    if cfg.objective in ("multiclass", "multiclassova") and cfg.num_class < 2:
+        raise ValueError("num_class must be >= 2 for multiclass objectives")
+    if cfg.boosting_type == "goss" and cfg.top_rate + cfg.other_rate > 1.0:
+        raise ValueError("top_rate + other_rate must be <= 1.0 for GOSS")
+    if cfg.tree_learner not in ("serial", "feature", "data", "voting"):
+        raise ValueError(f"unknown tree_learner: {cfg.tree_learner}")
+
+
+def parse_config_file(path: str) -> Dict[str, str]:
+    """Parse a LightGBM `key = value` config file (application.cpp:46-102)."""
+    params: Dict[str, str] = {}
+    with open(path, "r") as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line or "=" not in line:
+                continue
+            k, v = line.split("=", 1)
+            params[k.strip()] = v.strip()
+    return params
+
+
+def parse_cli_args(argv: List[str]) -> Dict[str, str]:
+    """Parse `key=value` command line tokens (application.cpp:46-70)."""
+    params: Dict[str, str] = {}
+    for tok in argv:
+        if "=" in tok:
+            k, v = tok.split("=", 1)
+            params[k.strip()] = v.strip()
+    resolved = apply_aliases(params)
+    if "config_file" in resolved and resolved["config_file"]:
+        file_params = parse_config_file(resolved["config_file"])
+        for k, v in file_params.items():
+            params.setdefault(k, v)
+    return params
+
+
+def default_metric_for_objective(objective: str) -> str:
+    return {
+        "regression": "l2",
+        "regression_l1": "l1",
+        "huber": "huber",
+        "fair": "fair",
+        "poisson": "poisson",
+        "binary": "binary_logloss",
+        "multiclass": "multi_logloss",
+        "multiclassova": "multi_logloss",
+        "lambdarank": "ndcg",
+    }.get(objective, "l2")

@@ -1,0 +1,275 @@
+"""Binned Dataset: the device-resident training matrix.
+
+TPU re-design of the reference IO layer (/root/reference/src/io/):
+
+- `Metadata` — labels/weights/query boundaries/init score incl. the
+  `<data>.weight` / `<data>.init` / `<data>.query` side files
+  (metadata.cpp:372-437).
+- text `Parser` — CSV / TSV / LibSVM auto-detection (parser.cpp).
+- `Dataset` — instead of the reference's FeatureGroup/DenseBin/SparseBin/
+  OrderedBin class zoo (dense_bin.hpp, sparse_bin.hpp, ordered_sparse_bin.hpp),
+  ONE dense `[num_used_features, num_rows]` uint8/uint16 array of bin ids,
+  padded with a sentinel row slot so masked gathers are branch-free.  Binned
+  values are ~1 byte each, so even Epsilon-scale data fits HBM dense; there
+  is no sparse path on TPU (SURVEY.md §7 "start dense").
+
+Validation datasets are binned with the training set's BinMappers
+(reference Dataset::CheckAlign + LoadFromFileAlignWithOtherDataset,
+dataset_loader.cpp:220-261).
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .binning import BinMapper, find_bin_mappers, CATEGORICAL, NUMERICAL
+from .config import Config
+
+
+# ----------------------------------------------------------------------------
+# Text parsing (reference src/io/parser.cpp)
+# ----------------------------------------------------------------------------
+
+def _detect_format(line: str) -> str:
+    """Probe one line: 'libsvm' | 'tsv' | 'csv' (parser.cpp format probing)."""
+    toks = line.strip().split()
+    if len(toks) > 1 and ":" in toks[1]:
+        return "libsvm"
+    if "\t" in line:
+        return "tsv"
+    if "," in line:
+        return "csv"
+    return "tsv"  # space separated handled like tsv
+
+
+def parse_text_file(path: str, has_header: bool = False, label_idx: int = 0
+                    ) -> Tuple[np.ndarray, np.ndarray, Optional[List[str]]]:
+    """Parse a CSV/TSV/LibSVM data file into (X, y, feature_names).
+
+    Auto-detects the format from the first data line like the reference
+    Parser::CreateParser.  The label is column `label_idx` for csv/tsv and
+    the first token for libsvm.
+    """
+    with open(path, "r") as f:
+        first = f.readline()
+        if not first:
+            raise ValueError(f"empty data file: {path}")
+    header_names: Optional[List[str]] = None
+    skip = 0
+    if has_header:
+        sep = "\t" if "\t" in first else ("," if "," in first else None)
+        header_names = [t.strip() for t in first.strip().split(sep)]
+        skip = 1
+        with open(path, "r") as f:
+            f.readline()
+            first = f.readline()
+    fmt = _detect_format(first)
+    if fmt == "libsvm":
+        labels: List[float] = []
+        rows: List[Dict[int, float]] = []
+        max_idx = -1
+        with open(path, "r") as f:
+            for _ in range(skip):
+                f.readline()
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                toks = line.split()
+                labels.append(float(toks[0]))
+                row: Dict[int, float] = {}
+                for t in toks[1:]:
+                    if ":" not in t:
+                        continue
+                    k, v = t.split(":", 1)
+                    ki = int(k)
+                    row[ki] = float(v)
+                    max_idx = max(max_idx, ki)
+                rows.append(row)
+        X = np.zeros((len(rows), max_idx + 1), dtype=np.float64)
+        for i, row in enumerate(rows):
+            for k, v in row.items():
+                X[i, k] = v
+        return X, np.asarray(labels, dtype=np.float64), header_names
+    sep = "\t" if fmt == "tsv" else ","
+    raw = np.loadtxt(path, delimiter=None if sep == "\t" else sep,
+                     skiprows=skip, dtype=np.float64, ndmin=2)
+    y = raw[:, label_idx].copy()
+    X = np.delete(raw, label_idx, axis=1)
+    return X, y, header_names
+
+
+# ----------------------------------------------------------------------------
+# Metadata (reference include/LightGBM/dataset.h:36-248, src/io/metadata.cpp)
+# ----------------------------------------------------------------------------
+
+@dataclass
+class Metadata:
+    label: np.ndarray = field(default_factory=lambda: np.zeros(0, np.float32))
+    weights: Optional[np.ndarray] = None        # fp32 [N]
+    query_boundaries: Optional[np.ndarray] = None  # int32 [num_queries+1]
+    init_score: Optional[np.ndarray] = None     # fp64 [N * num_tree_per_iter]
+
+    @property
+    def num_data(self) -> int:
+        return int(self.label.shape[0])
+
+    @property
+    def num_queries(self) -> int:
+        return 0 if self.query_boundaries is None else len(self.query_boundaries) - 1
+
+    def set_query_from_sizes(self, sizes: np.ndarray) -> None:
+        """group sizes -> boundaries (metadata.cpp query loading)."""
+        sizes = np.asarray(sizes, dtype=np.int64)
+        self.query_boundaries = np.concatenate(
+            [[0], np.cumsum(sizes)]).astype(np.int32)
+
+    @staticmethod
+    def load_side_files(data_path: str, num_data: int) -> "Metadata":
+        """Load `<data>.weight`, `<data>.init`, `<data>.query` if present
+        (metadata.cpp:372-437)."""
+        md = Metadata()
+        wpath = data_path + ".weight"
+        if os.path.exists(wpath):
+            md.weights = np.loadtxt(wpath, dtype=np.float32).reshape(-1)
+        ipath = data_path + ".init"
+        if os.path.exists(ipath):
+            md.init_score = np.loadtxt(ipath, dtype=np.float64).reshape(-1)
+        qpath = data_path + ".query"
+        if os.path.exists(qpath):
+            sizes = np.loadtxt(qpath, dtype=np.int64).reshape(-1)
+            md.set_query_from_sizes(sizes)
+        return md
+
+
+# ----------------------------------------------------------------------------
+# Dataset
+# ----------------------------------------------------------------------------
+
+def _parse_categorical_column(spec: str, feature_names: Optional[List[str]],
+                              num_features: int) -> List[int]:
+    """Parse the `categorical_column` selector (index list or name: prefix,
+    dataset_loader.cpp:22-157)."""
+    if not spec:
+        return []
+    out: List[int] = []
+    if spec.startswith("name:"):
+        if not feature_names:
+            raise ValueError("categorical_column=name: requires a header")
+        wanted = spec[5:].split(",")
+        for w in wanted:
+            out.append(feature_names.index(w.strip()))
+    else:
+        for tok in spec.replace(",", " ").split():
+            out.append(int(tok))
+    return [i for i in out if 0 <= i < num_features]
+
+
+class Dataset:
+    """Binned feature matrix + metadata.
+
+    Attributes
+    ----------
+    bins : np.ndarray  [num_used_features, num_data] uint8/uint16 bin ids
+    num_bins : np.ndarray [num_used_features] int32 per-feature bin counts
+    mappers : list[BinMapper], one per RAW feature
+    used_features : list[int] raw indices of non-trivial features
+    """
+
+    def __init__(self, X: np.ndarray, label: Optional[np.ndarray] = None,
+                 config: Optional[Config] = None,
+                 reference: Optional["Dataset"] = None,
+                 metadata: Optional[Metadata] = None,
+                 feature_names: Optional[List[str]] = None,
+                 categorical_feature: Sequence[int] = ()):
+        cfg = config or Config()
+        X = np.ascontiguousarray(np.asarray(X, dtype=np.float64))
+        if X.ndim != 2:
+            raise ValueError("X must be 2-dimensional")
+        n, num_raw = X.shape
+        self.num_data = n
+        self.num_total_features = num_raw
+        self.config = cfg
+        self.feature_names = feature_names or [f"Column_{i}" for i in range(num_raw)]
+
+        if reference is not None:
+            # align with reference (valid set): reuse its mappers
+            if num_raw != reference.num_total_features:
+                raise ValueError("validation data has different #features")
+            self.mappers = reference.mappers
+            self.used_features = reference.used_features
+        else:
+            self.mappers = find_bin_mappers(
+                X, cfg.max_bin, cfg.min_data_in_bin, cfg.min_data_in_leaf,
+                categorical=categorical_feature,
+                sample_cnt=cfg.bin_construct_sample_cnt,
+                seed=cfg.data_random_seed)
+            self.used_features = [i for i, m in enumerate(self.mappers)
+                                  if not m.is_trivial]
+        F = len(self.used_features)
+        self.num_bins = np.array(
+            [self.mappers[i].num_bin for i in self.used_features], dtype=np.int32)
+        self.max_num_bin = int(self.num_bins.max()) if F else 1
+        dtype = np.uint8 if self.max_num_bin <= 256 else np.uint16
+        self.bins = np.empty((F, n), dtype=dtype)
+        for k, i in enumerate(self.used_features):
+            self.bins[k] = self.mappers[i].value_to_bin(X[:, i]).astype(dtype)
+        self.is_categorical = np.array(
+            [self.mappers[i].bin_type == CATEGORICAL for i in self.used_features],
+            dtype=bool)
+
+        md = metadata or Metadata()
+        if label is not None:
+            md.label = np.asarray(label, dtype=np.float32).reshape(-1)
+        if md.label.size == 0:
+            md.label = np.zeros(n, dtype=np.float32)
+        if md.label.size != n:
+            raise ValueError("label length mismatch")
+        self.metadata = md
+        self._device_bins = None
+
+    # -- helpers ------------------------------------------------------------
+
+    @property
+    def num_features(self) -> int:
+        return len(self.used_features)
+
+    def inner_to_real(self, inner: int) -> int:
+        return self.used_features[inner]
+
+    def real_to_inner(self, real: int) -> int:
+        return self.used_features.index(real)
+
+    def device_bins(self):
+        """[F, N+1] device array with a sentinel row slot at index N
+        (bin 0, weight 0) so padded gathers need no branches."""
+        if self._device_bins is None:
+            import jax.numpy as jnp
+            padded = np.concatenate(
+                [self.bins, np.zeros((self.num_features, 1), self.bins.dtype)],
+                axis=1)
+            self._device_bins = jnp.asarray(padded.astype(np.int8 if
+                padded.dtype == np.uint8 else np.int16))
+        return self._device_bins
+
+    def feature_infos(self) -> List[str]:
+        return [m.feature_info() for m in self.mappers]
+
+    @staticmethod
+    def from_file(path: str, config: Optional[Config] = None,
+                  reference: Optional["Dataset"] = None) -> "Dataset":
+        cfg = config or Config()
+        label_idx = 0
+        if cfg.label_column.startswith("name:"):
+            raise NotImplementedError("label by name requires header support")
+        elif cfg.label_column:
+            label_idx = int(cfg.label_column)
+        X, y, names = parse_text_file(path, cfg.has_header, label_idx)
+        md = Metadata.load_side_files(path, len(y))
+        cats = _parse_categorical_column(cfg.categorical_column, names, X.shape[1])
+        ds = Dataset(X, y, cfg, reference=reference, metadata=md,
+                     feature_names=names, categorical_feature=cats)
+        return ds

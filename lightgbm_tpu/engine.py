@@ -1,0 +1,214 @@
+"""train() / cv() — the callback-driven training loop.
+
+Mirrors /root/reference/python-package/lightgbm/engine.py: train()
+(engine.py:17-203) with init_model continuation, client-side early stopping
+via callbacks, evals_result recording; cv() (engine.py:279+) with
+(stratified) folds.
+"""
+from __future__ import annotations
+
+import collections
+import copy
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .basic import Booster, Dataset, LightGBMError
+from . import callback as callback_mod
+
+
+def train(params: Dict[str, Any], train_set: Dataset,
+          num_boost_round: int = 100,
+          valid_sets: Optional[Union[Dataset, List[Dataset]]] = None,
+          valid_names: Optional[List[str]] = None,
+          fobj: Optional[Callable] = None, feval: Optional[Callable] = None,
+          init_model: Optional[Union[str, Booster]] = None,
+          feature_name: str = "auto", categorical_feature: str = "auto",
+          early_stopping_rounds: Optional[int] = None,
+          evals_result: Optional[Dict] = None, verbose_eval: Union[bool, int] = True,
+          learning_rates: Optional[Union[List[float], Callable]] = None,
+          callbacks: Optional[List[Callable]] = None) -> Booster:
+    params = dict(params or {})
+    for alias in ("num_iterations", "num_iteration", "num_trees", "num_tree",
+                  "num_rounds", "num_round"):
+        if alias in params:
+            num_boost_round = int(params.pop(alias))
+            break
+    if fobj is not None:
+        params["objective"] = params.get("objective", "regression")
+    if feature_name != "auto":
+        train_set.feature_name = feature_name
+    if categorical_feature != "auto":
+        train_set.categorical_feature = categorical_feature
+
+    booster = Booster(params=params, train_set=train_set)
+    # continuation from an init model: seed scores with its predictions
+    # (reference engine.py:91-98 _InnerPredictor path)
+    if init_model is not None:
+        if isinstance(init_model, str):
+            init_booster = Booster(model_file=init_model, params=params)
+        else:
+            init_booster = init_model
+        train_set.construct(params)
+        init_raw = init_booster.predict(train_set._raw_X
+                                        if train_set._raw_X is not None
+                                        else train_set.data, raw_score=True)
+        train_set.set_init_score(np.asarray(init_raw, np.float64).T.reshape(-1))
+        booster = Booster(params=params, train_set=train_set)
+        booster._init_trees = init_booster  # keep for prediction merge
+        booster._gbdt.models = ([t for t in init_booster._gbdt.models]
+                                + booster._gbdt.models)
+        booster._gbdt.num_init_iteration = init_booster._gbdt.current_iteration()
+        booster._gbdt.boost_from_average_used = (
+            init_booster._gbdt.boost_from_average_used)
+
+    if valid_sets is not None:
+        if isinstance(valid_sets, Dataset):
+            valid_sets = [valid_sets]
+        for i, vs in enumerate(valid_sets):
+            if vs is train_set:
+                name = "training"
+            elif valid_names is not None and i < len(valid_names):
+                name = valid_names[i]
+            else:
+                name = f"valid_{i}"
+            if vs is not train_set:
+                if vs.reference is None:
+                    vs.reference = train_set
+                booster.add_valid(vs, name)
+
+    cbs = set(callbacks or [])
+    if verbose_eval is True:
+        cbs.add(callback_mod.print_evaluation())
+    elif isinstance(verbose_eval, int) and verbose_eval:
+        cbs.add(callback_mod.print_evaluation(verbose_eval))
+    if early_stopping_rounds is not None and early_stopping_rounds > 0:
+        cbs.add(callback_mod.early_stopping(
+            early_stopping_rounds, verbose=bool(verbose_eval)))
+    if evals_result is not None:
+        cbs.add(callback_mod.record_evaluation(evals_result))
+    if learning_rates is not None:
+        cbs.add(callback_mod.reset_parameter(learning_rate=learning_rates))
+    cbs_before = sorted((cb for cb in cbs
+                         if getattr(cb, "before_iteration", False)),
+                        key=lambda cb: getattr(cb, "order", 0))
+    cbs_after = sorted((cb for cb in cbs
+                        if not getattr(cb, "before_iteration", False)),
+                       key=lambda cb: getattr(cb, "order", 0))
+
+    has_valid = bool(booster._valid_names)
+    train_in_valid = (valid_sets is not None
+                      and any(vs is train_set for vs in valid_sets))
+    for i in range(num_boost_round):
+        env = callback_mod.CallbackEnv(
+            model=booster, params=params, iteration=i, begin_iteration=0,
+            end_iteration=num_boost_round, evaluation_result_list=None)
+        for cb in cbs_before:
+            cb(env)
+        finished = booster.update(fobj=fobj)
+        evaluation_result_list = []
+        if train_in_valid or params.get("is_training_metric"):
+            evaluation_result_list.extend(booster.eval_train(feval))
+        if has_valid:
+            evaluation_result_list.extend(booster.eval_valid(feval))
+        env = env._replace(evaluation_result_list=evaluation_result_list)
+        try:
+            for cb in cbs_after:
+                cb(env)
+        except callback_mod.EarlyStopException as e:
+            booster.best_iteration = e.best_iteration + 1
+            break
+        if finished:
+            break
+    if booster.best_iteration <= 0:
+        booster.best_iteration = booster.current_iteration()
+    return booster
+
+
+def _make_n_folds(full_data: Dataset, nfold: int, params, seed: int,
+                  stratified: bool = False, shuffle: bool = True):
+    full_data.construct(params)
+    num_data = full_data.num_data()
+    rng = np.random.RandomState(seed)
+    if stratified:
+        label = np.asarray(full_data.get_label())
+        order = np.argsort(label, kind="stable")
+        if shuffle:
+            # round-robin assignment over sorted labels keeps folds stratified
+            folds_idx = [order[i::nfold] for i in range(nfold)]
+        else:
+            folds_idx = [order[i::nfold] for i in range(nfold)]
+    else:
+        idx = np.arange(num_data)
+        if shuffle:
+            rng.shuffle(idx)
+        folds_idx = np.array_split(idx, nfold)
+    for k in range(nfold):
+        test_idx = np.sort(np.asarray(folds_idx[k]))
+        train_mask = np.ones(num_data, bool)
+        train_mask[test_idx] = False
+        train_idx = np.flatnonzero(train_mask)
+        yield train_idx, test_idx
+
+
+def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 10,
+       folds=None, nfold: int = 5, stratified: bool = False,
+       shuffle: bool = True, metrics=None, fobj=None, feval=None,
+       init_model=None, feature_name="auto", categorical_feature="auto",
+       early_stopping_rounds=None, fpreproc=None, verbose_eval=None,
+       show_stdv: bool = True, seed: int = 0,
+       callbacks=None) -> Dict[str, List[float]]:
+    """K-fold cross validation (reference engine.py:279+).
+
+    Returns {metric-name-mean: [...], metric-name-stdv: [...]}.
+    """
+    params = dict(params or {})
+    if metrics is not None:
+        params["metric"] = metrics
+    train_set.construct(params)
+    if folds is None:
+        folds = list(_make_n_folds(train_set, nfold, params, seed, stratified,
+                                   shuffle))
+    boosters = []
+    for train_idx, test_idx in folds:
+        tr = train_set.subset(train_idx, params)
+        te = train_set.subset(test_idx, params)
+        if fpreproc is not None:
+            tr, te, params = fpreproc(tr, te, params.copy())
+        bst = Booster(params=params, train_set=tr)
+        bst.add_valid(te, "valid")
+        boosters.append(bst)
+
+    results = collections.defaultdict(list)
+    best_iter = num_boost_round
+    history = collections.defaultdict(list)
+    for i in range(num_boost_round):
+        agg = collections.defaultdict(list)
+        for bst in boosters:
+            bst.update(fobj=fobj)
+            for _, name, val, hib in bst.eval_valid(feval):
+                agg[(name, hib)].append(val)
+        line = {}
+        for (name, hib), vals in agg.items():
+            mean, std = float(np.mean(vals)), float(np.std(vals))
+            results[name + "-mean"].append(mean)
+            results[name + "-stdv"].append(std)
+            line[(name, hib)] = mean
+        if verbose_eval:
+            msg = "\t".join(f"cv_agg {n}-mean: {results[n + '-mean'][-1]:g}"
+                            for n in set(k[0] for k in agg))
+            print(f"[{i + 1}]\t{msg}")
+        if early_stopping_rounds:
+            for (name, hib), mean in line.items():
+                history[name].append(mean if hib else -mean)
+            stop = False
+            for name, h in history.items():
+                bi = int(np.argmax(h))
+                if len(h) - 1 - bi >= early_stopping_rounds:
+                    best_iter = bi + 1
+                    stop = True
+            if stop:
+                for key in results:
+                    del results[key][best_iter:]
+                break
+    return dict(results)

@@ -1,0 +1,285 @@
+"""Evaluation metrics.
+
+Parity with /root/reference/src/metric/ (factory metric.cpp:10-40):
+l1/l2/huber/fair/poisson (regression_metric.hpp), binary_logloss/
+binary_error/auc (binary_metric.hpp), multi_logloss/multi_error
+(multiclass_metric.hpp), ndcg@k (rank_metric.hpp) and map@k
+(map_metric.hpp), with the shared DCG tables (dcg_calculator.cpp).
+
+Metrics run on the host in float64 (the reference also evaluates in
+double); scores are fetched from device once per eval.  Each metric
+reports `factor_to_bigger_better` (+1/-1) so early stopping can maximize
+uniformly (metric.h:32).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .config import Config
+from .dataset import Metadata
+
+
+class Metric:
+    name = "metric"
+    factor_to_bigger_better = -1.0  # losses by default
+
+    def __init__(self, config: Config):
+        self.config = config
+
+    def init(self, metadata: Metadata, num_data: int) -> None:
+        self.num_data = num_data
+        self.label = np.asarray(metadata.label, np.float64)
+        self.weights = (None if metadata.weights is None
+                        else np.asarray(metadata.weights, np.float64))
+        self.sum_weights = (float(num_data) if self.weights is None
+                            else float(self.weights.sum()))
+        self.metadata = metadata
+
+    def eval(self, score: np.ndarray, objective=None) -> List[Tuple[str, float]]:
+        """score: [N] or [K, N] raw scores.  Returns [(name, value)]."""
+        raise NotImplementedError
+
+    def _avg(self, losses: np.ndarray) -> float:
+        if self.weights is None:
+            return float(losses.sum() / self.sum_weights)
+        return float((losses * self.weights).sum() / self.sum_weights)
+
+
+class L2Metric(Metric):
+    name = "l2"
+
+    def eval(self, score, objective=None):
+        d = score.reshape(-1) - self.label
+        return [(self.name, self._avg(d * d))]
+
+
+class RMSEMetric(L2Metric):
+    name = "rmse"
+
+    def eval(self, score, objective=None):
+        return [(self.name, float(np.sqrt(super().eval(score)[0][1])))]
+
+
+class L1Metric(Metric):
+    name = "l1"
+
+    def eval(self, score, objective=None):
+        return [(self.name, self._avg(np.abs(score.reshape(-1) - self.label)))]
+
+
+class HuberMetric(Metric):
+    name = "huber"
+
+    def eval(self, score, objective=None):
+        delta = self.config.huber_delta
+        d = np.abs(score.reshape(-1) - self.label)
+        loss = np.where(d <= delta, 0.5 * d * d,
+                        delta * (d - 0.5 * delta))
+        return [(self.name, self._avg(loss))]
+
+
+class FairMetric(Metric):
+    name = "fair"
+
+    def eval(self, score, objective=None):
+        c = self.config.fair_c
+        x = np.abs(score.reshape(-1) - self.label)
+        loss = c * x - c * c * np.log1p(x / c)
+        return [(self.name, self._avg(loss))]
+
+
+class PoissonMetric(Metric):
+    name = "poisson"
+
+    def eval(self, score, objective=None):
+        s = score.reshape(-1)
+        eps = 1e-10
+        s = np.where(s < eps, eps, s)
+        loss = s - self.label * np.log(s)
+        return [(self.name, self._avg(loss))]
+
+
+class BinaryLoglossMetric(Metric):
+    name = "binary_logloss"
+
+    def eval(self, score, objective=None):
+        sigmoid = self.config.sigmoid
+        s = score.reshape(-1)
+        prob = 1.0 / (1.0 + np.exp(-sigmoid * s))
+        prob = np.clip(prob, 1e-15, 1 - 1e-15)
+        y = self.label > 0
+        loss = -np.where(y, np.log(prob), np.log(1 - prob))
+        return [(self.name, self._avg(loss))]
+
+
+class BinaryErrorMetric(Metric):
+    name = "binary_error"
+
+    def eval(self, score, objective=None):
+        s = score.reshape(-1)
+        pred_pos = s > 0
+        err = (pred_pos != (self.label > 0)).astype(np.float64)
+        return [(self.name, self._avg(err))]
+
+
+class AUCMetric(Metric):
+    name = "auc"
+    factor_to_bigger_better = 1.0
+
+    def eval(self, score, objective=None):
+        """Weighted, tie-aware rank-sum AUC (binary_metric.hpp:156+)."""
+        s = score.reshape(-1)
+        y = self.label > 0
+        w = (np.ones_like(s) if self.weights is None else self.weights)
+        order = np.argsort(s, kind="stable")
+        s_s, y_s, w_s = s[order], y[order], w[order]
+        wpos = np.where(y_s, w_s, 0.0)
+        wneg = np.where(y_s, 0.0, w_s)
+        # group ties: for each tied block, pairs count half
+        cneg = np.cumsum(wneg) - wneg  # negatives strictly below, pre-tie
+        # build tie-block ids
+        new_block = np.empty(len(s_s), bool)
+        new_block[0] = True
+        new_block[1:] = s_s[1:] != s_s[:-1]
+        block_id = np.cumsum(new_block) - 1
+        nb = block_id[-1] + 1 if len(s_s) else 0
+        bpos = np.zeros(nb); bneg = np.zeros(nb)
+        np.add.at(bpos, block_id, wpos)
+        np.add.at(bneg, block_id, wneg)
+        below = np.concatenate([[0.0], np.cumsum(bneg)[:-1]])
+        acc = float((bpos * (below + 0.5 * bneg)).sum())
+        tot_pos, tot_neg = float(wpos.sum()), float(wneg.sum())
+        if tot_pos <= 0 or tot_neg <= 0:
+            return [(self.name, 1.0)]
+        return [(self.name, acc / (tot_pos * tot_neg))]
+
+
+class MultiLoglossMetric(Metric):
+    name = "multi_logloss"
+
+    def eval(self, score, objective=None):
+        K = self.config.num_class
+        s = score.reshape(K, -1)
+        m = s.max(axis=0, keepdims=True)
+        e = np.exp(s - m)
+        p = e / e.sum(axis=0, keepdims=True)
+        lab = self.label.astype(np.int64)
+        pl = np.clip(p[lab, np.arange(s.shape[1])], 1e-15, None)
+        return [(self.name, self._avg(-np.log(pl)))]
+
+
+class MultiErrorMetric(Metric):
+    name = "multi_error"
+
+    def eval(self, score, objective=None):
+        K = self.config.num_class
+        s = score.reshape(K, -1)
+        pred = s.argmax(axis=0)
+        err = (pred != self.label.astype(np.int64)).astype(np.float64)
+        return [(self.name, self._avg(err))]
+
+
+def _dcg_tables(config: Config, max_len: int):
+    gains = config.label_gain
+    if not gains:
+        gains = tuple(float(2 ** i - 1) for i in range(31))
+    label_gain = np.asarray(gains, np.float64)
+    discount = 1.0 / np.log2(2.0 + np.arange(max(max_len, 1)))
+    return label_gain, discount
+
+
+class NDCGMetric(Metric):
+    name = "ndcg"
+    factor_to_bigger_better = 1.0
+
+    def eval(self, score, objective=None):
+        qb = self.metadata.query_boundaries
+        if qb is None:
+            raise ValueError("NDCG metric requires query information")
+        ks = list(self.config.ndcg_eval_at)
+        s = score.reshape(-1)
+        lab = self.label.astype(np.int64)
+        Q = len(qb) - 1
+        maxlen = int(np.max(np.diff(qb)))
+        label_gain, discount = _dcg_tables(self.config, maxlen)
+        # per-query weights (reference: query weights or 1)
+        sums = np.zeros(len(ks))
+        wsum = 0.0
+        for q in range(Q):
+            lo, hi = qb[q], qb[q + 1]
+            lq, sq = lab[lo:hi], s[lo:hi]
+            n = hi - lo
+            order = np.argsort(-sq, kind="stable")
+            gains_sorted = label_gain[lq[order]]
+            ideal = label_gain[np.sort(lq)[::-1]]
+            w = 1.0
+            wsum += w
+            for i, k in enumerate(ks):
+                kk = min(k, n)
+                maxdcg = float((ideal[:kk] * discount[:kk]).sum())
+                if maxdcg <= 0:
+                    sums[i] += w  # reference: all-zero-gain query counts as 1
+                else:
+                    dcg = float((gains_sorted[:kk] * discount[:kk]).sum())
+                    sums[i] += w * dcg / maxdcg
+        return [(f"ndcg@{k}", float(sums[i] / wsum)) for i, k in enumerate(ks)]
+
+
+class MAPMetric(Metric):
+    name = "map"
+    factor_to_bigger_better = 1.0
+
+    def eval(self, score, objective=None):
+        qb = self.metadata.query_boundaries
+        if qb is None:
+            raise ValueError("MAP metric requires query information")
+        ks = list(self.config.ndcg_eval_at)
+        s = score.reshape(-1)
+        lab = self.label > 0
+        Q = len(qb) - 1
+        sums = np.zeros(len(ks))
+        wsum = 0.0
+        for q in range(Q):
+            lo, hi = qb[q], qb[q + 1]
+            lq, sq = lab[lo:hi], s[lo:hi]
+            order = np.argsort(-sq, kind="stable")
+            rel = lq[order].astype(np.float64)
+            hits = np.cumsum(rel)
+            prec = hits / (1.0 + np.arange(len(rel)))
+            w = 1.0
+            wsum += w
+            for i, k in enumerate(ks):
+                kk = min(k, len(rel))
+                nrel = rel[:kk].sum()
+                if nrel > 0:
+                    sums[i] += w * float((prec[:kk] * rel[:kk]).sum() / nrel)
+        return [(f"map@{k}", float(sums[i] / wsum)) for i, k in enumerate(ks)]
+
+
+_METRICS = {
+    "l2": L2Metric, "mse": L2Metric, "mean_squared_error": L2Metric,
+    "regression": L2Metric,
+    "rmse": RMSEMetric,
+    "l1": L1Metric, "mae": L1Metric, "mean_absolute_error": L1Metric,
+    "huber": HuberMetric,
+    "fair": FairMetric,
+    "poisson": PoissonMetric,
+    "binary_logloss": BinaryLoglossMetric, "binary": BinaryLoglossMetric,
+    "binary_error": BinaryErrorMetric,
+    "auc": AUCMetric,
+    "multi_logloss": MultiLoglossMetric, "multiclass": MultiLoglossMetric,
+    "multi_error": MultiErrorMetric,
+    "ndcg": NDCGMetric, "lambdarank": NDCGMetric,
+    "map": MAPMetric, "mean_average_precision": MAPMetric,
+}
+
+
+def create_metric(name: str, config: Config) -> Optional[Metric]:
+    name = name.strip().lower()
+    if name in ("", "none", "null", "na"):
+        return None
+    if name not in _METRICS:
+        raise ValueError(f"unknown metric: {name}")
+    return _METRICS[name](config)

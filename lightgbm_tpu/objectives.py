@@ -1,0 +1,493 @@
+"""Objective functions: jitted elementwise gradient/hessian kernels.
+
+Parity with /root/reference/src/objective/ (factory objective_function.cpp:9-31):
+regression (L2), regression_l1, huber, fair, poisson
+(regression_objective.hpp), binary (binary_objective.hpp:45-113),
+multiclass softmax / multiclassova (multiclass_objective.hpp), lambdarank
+(rank_objective.hpp:19-242).
+
+Scores and gradients are `[K, N]` float32 device arrays (K = trees per
+iteration; the reference uses a flat class-major buffer, gbdt.cpp:648-656).
+The reference's per-row OMP loops become one fused elementwise XLA program;
+LambdaRank's per-query pairwise loop becomes a padded `[Q, D, D]` masked
+computation chunked over queries (no sigmoid lookup table needed — the VPU
+evaluates exp directly; rank_objective.hpp:173-199 is a CPU-ism).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import Config
+from .dataset import Metadata
+
+
+def softmax(x, axis=-1):
+    m = jnp.max(x, axis=axis, keepdims=True)
+    e = jnp.exp(x - m)
+    return e / jnp.sum(e, axis=axis, keepdims=True)
+
+
+class Objective:
+    """Base objective.  get_gradients: [K, N] score -> ([K, N], [K, N])."""
+
+    name = "regression"
+    num_tree_per_iteration = 1
+    is_constant_hessian = False
+    boost_from_average = False
+
+    def __init__(self, config: Config):
+        self.config = config
+
+    def init(self, metadata: Metadata, num_data: int) -> None:
+        self.num_data = num_data
+        self.label = jnp.asarray(metadata.label, jnp.float32)
+        self.weights = (None if metadata.weights is None
+                        else jnp.asarray(metadata.weights, jnp.float32))
+
+    def get_gradients(self, score: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        raise NotImplementedError
+
+    def convert_output(self, score: np.ndarray) -> np.ndarray:
+        """Raw score -> prediction output (reference ConvertOutput)."""
+        return score
+
+    def initial_score(self) -> float:
+        """boost_from_average seed value (gbdt.cpp:333-355)."""
+        return 0.0
+
+    def to_string(self) -> str:
+        return self.name
+
+    def _apply_weights(self, g, h):
+        if self.weights is None:
+            return g, h
+        w = self.weights[None, :]
+        return g * w, h * w
+
+
+class RegressionL2(Objective):
+    name = "regression"
+    boost_from_average = True
+
+    @property
+    def is_constant_hessian(self):
+        return self.weights is None
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+
+        @jax.jit
+        def f(score, label, weights):
+            g = score - label[None, :]
+            h = jnp.ones_like(g)
+            if weights is not None:
+                g = g * weights[None, :]
+                h = h * weights[None, :]
+            return g, h
+        self._f = f
+
+    def get_gradients(self, score):
+        return self._f(score, self.label, self.weights)
+
+    def initial_score(self) -> float:
+        lab = np.asarray(self.label, np.float64)
+        if self.weights is not None:
+            w = np.asarray(self.weights, np.float64)
+            return float((lab * w).sum() / w.sum())
+        return float(lab.mean())
+
+
+class RegressionL1(Objective):
+    name = "regression_l1"
+    boost_from_average = True
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        eta = self.config.gaussian_eta
+
+        @jax.jit
+        def f(score, label, weights):
+            lab = label[None, :]
+            diff = score - lab
+            w = jnp.ones_like(score) if weights is None else weights[None, :]
+            g = jnp.where(diff >= 0.0, 1.0, -1.0) * w
+            h = w * _gaussian_hessian(score, lab, g, eta, w)
+            return g, h
+        self._f = f
+
+    def get_gradients(self, score):
+        return self._f(score, self.label, self.weights)
+
+    def initial_score(self) -> float:
+        return float(np.median(np.asarray(self.label, np.float64)))
+
+
+def _gaussian_hessian(y, t, g, eta, w):
+    """Common::ApproximateHessianWithGaussian (common.h:436-445); the
+    leading `w` factor is applied by the caller."""
+    diff = y - t
+    x = jnp.abs(diff)
+    a = 2.0 * jnp.abs(g)  # w already folded into g by callers
+    c = jnp.maximum((jnp.abs(y) + jnp.abs(t)) * eta, 1.0e-10)
+    return jnp.exp(-x * x / (2.0 * c * c)) * a / (c * jnp.sqrt(2 * jnp.pi))
+
+
+class RegressionHuber(Objective):
+    name = "huber"
+    boost_from_average = True
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        delta = self.config.huber_delta
+        eta = self.config.gaussian_eta
+
+        @jax.jit
+        def f(score, label, weights):
+            lab = label[None, :]
+            diff = score - lab
+            w = jnp.ones_like(score) if weights is None else weights[None, :]
+            small = jnp.abs(diff) <= delta
+            g = jnp.where(small, diff, jnp.sign(diff) * delta) * w
+            h_small = w
+            h_big = w * _gaussian_hessian(score, lab, jnp.sign(diff) * delta * w,
+                                          eta, w)
+            h = jnp.where(small, h_small, h_big)
+            return g, h
+        self._f = f
+
+    def get_gradients(self, score):
+        return self._f(score, self.label, self.weights)
+
+    def initial_score(self) -> float:
+        return float(np.mean(np.asarray(self.label, np.float64)))
+
+
+class RegressionFair(Objective):
+    name = "fair"
+    boost_from_average = True
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        c = self.config.fair_c
+
+        @jax.jit
+        def f(score, label, weights):
+            x = score - label[None, :]
+            w = jnp.ones_like(score) if weights is None else weights[None, :]
+            g = c * x / (jnp.abs(x) + c) * w
+            h = c * c / ((jnp.abs(x) + c) ** 2) * w
+            return g, h
+        self._f = f
+
+    def get_gradients(self, score):
+        return self._f(score, self.label, self.weights)
+
+    def initial_score(self) -> float:
+        return float(np.mean(np.asarray(self.label, np.float64)))
+
+
+class RegressionPoisson(Objective):
+    name = "poisson"
+    boost_from_average = True
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        mds = self.config.poisson_max_delta_step
+
+        @jax.jit
+        def f(score, label, weights):
+            g = score - label[None, :]
+            h = score + mds
+            if weights is not None:
+                g = g * weights[None, :]
+                h = h * weights[None, :]
+            return g, h
+        self._f = f
+
+    def get_gradients(self, score):
+        return self._f(score, self.label, self.weights)
+
+    def initial_score(self) -> float:
+        return float(np.mean(np.asarray(self.label, np.float64)))
+
+
+class BinaryLogloss(Objective):
+    name = "binary"
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.sigmoid = config.sigmoid
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        lab = np.asarray(metadata.label)
+        is_pos = lab > 0
+        cnt_pos, cnt_neg = int(is_pos.sum()), int((~is_pos).sum())
+        self.need_train = cnt_pos > 0 and cnt_neg > 0
+        w_pos, w_neg = 1.0, 1.0
+        if self.config.is_unbalance and cnt_pos > 0 and cnt_neg > 0:
+            if cnt_pos > cnt_neg:
+                w_neg = cnt_pos / cnt_neg
+            else:
+                w_pos = cnt_neg / cnt_pos
+        w_pos *= self.config.scale_pos_weight
+        sigmoid = self.sigmoid
+
+        @jax.jit
+        def f(score, label, weights):
+            is_p = label[None, :] > 0
+            lbl = jnp.where(is_p, 1.0, -1.0)
+            lw = jnp.where(is_p, w_pos, w_neg)
+            response = -lbl * sigmoid / (1.0 + jnp.exp(lbl * sigmoid * score))
+            absr = jnp.abs(response)
+            g = response * lw
+            h = absr * (sigmoid - absr) * lw
+            if weights is not None:
+                g = g * weights[None, :]
+                h = h * weights[None, :]
+            return g, h
+        self._f = f
+
+    def get_gradients(self, score):
+        if not self.need_train:
+            z = jnp.zeros_like(score)
+            return z, z
+        return self._f(score, self.label, self.weights)
+
+    def convert_output(self, score):
+        return 1.0 / (1.0 + np.exp(-self.sigmoid * score))
+
+    def to_string(self):
+        return f"binary sigmoid:{self.sigmoid:g}"
+
+
+class MulticlassSoftmax(Objective):
+    name = "multiclass"
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.num_class = config.num_class
+        self.num_tree_per_iteration = config.num_class
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        lab = np.asarray(metadata.label).astype(np.int32)
+        if lab.min() < 0 or lab.max() >= self.num_class:
+            raise ValueError(
+                f"Label must be in [0, {self.num_class}) for multiclass")
+        self._label_int = jnp.asarray(lab)
+
+        @jax.jit
+        def f(score, label_int, weights):
+            p = softmax(score, axis=0)                       # [K, N]
+            onehot = (jax.lax.broadcasted_iota(jnp.int32, p.shape, 0)
+                      == label_int[None, :])
+            g = p - onehot.astype(p.dtype)
+            h = 2.0 * p * (1.0 - p)
+            if weights is not None:
+                g = g * weights[None, :]
+                h = h * weights[None, :]
+            return g, h
+        self._f = f
+
+    def get_gradients(self, score):
+        return self._f(score, self._label_int, self.weights)
+
+    def convert_output(self, score):
+        e = np.exp(score - score.max(axis=-1, keepdims=True))
+        return e / e.sum(axis=-1, keepdims=True)
+
+    def to_string(self):
+        return f"multiclass num_class:{self.num_class}"
+
+
+class MulticlassOVA(Objective):
+    name = "multiclassova"
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.num_class = config.num_class
+        self.num_tree_per_iteration = config.num_class
+        self.sigmoid = config.sigmoid
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        lab = np.asarray(metadata.label).astype(np.int32)
+        self._label_int = jnp.asarray(lab)
+        sigmoid = self.sigmoid
+
+        @jax.jit
+        def f(score, label_int, weights):
+            is_p = (jax.lax.broadcasted_iota(jnp.int32, score.shape, 0)
+                    == label_int[None, :])
+            lbl = jnp.where(is_p, 1.0, -1.0)
+            response = -lbl * sigmoid / (1.0 + jnp.exp(lbl * sigmoid * score))
+            absr = jnp.abs(response)
+            g = response
+            h = absr * (sigmoid - absr)
+            if weights is not None:
+                g = g * weights[None, :]
+                h = h * weights[None, :]
+            return g, h
+        self._f = f
+
+    def get_gradients(self, score):
+        return self._f(score, self._label_int, self.weights)
+
+    def convert_output(self, score):
+        return 1.0 / (1.0 + np.exp(-self.sigmoid * score))
+
+    def to_string(self):
+        return f"multiclassova num_class:{self.num_class} sigmoid:{self.sigmoid:g}"
+
+
+class LambdarankNDCG(Objective):
+    name = "lambdarank"
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if metadata.query_boundaries is None:
+            raise ValueError("Lambdarank tasks require query information")
+        qb = np.asarray(metadata.query_boundaries, np.int64)
+        self.num_queries = len(qb) - 1
+        sizes = np.diff(qb)
+        D = int(sizes.max())
+        Q = self.num_queries
+        # padded doc-index matrix; pad slots point at sentinel N
+        doc_idx = np.full((Q, D), num_data, np.int32)
+        for q in range(Q):
+            doc_idx[q, : sizes[q]] = np.arange(qb[q], qb[q + 1])
+        gains = self.config.label_gain
+        if not gains:
+            gains = tuple(float(2 ** i - 1) for i in range(31))
+        label_gain = np.asarray(gains, np.float64)
+        lab = np.asarray(metadata.label).astype(np.int32)
+        # inverse max DCG per query at max_position (rank_objective.hpp:60-69)
+        k = self.config.max_position
+        inv_max_dcg = np.zeros(Q)
+        discount = 1.0 / np.log2(2.0 + np.arange(D))
+        for q in range(Q):
+            lq = np.sort(lab[qb[q]: qb[q + 1]])[::-1][:k]
+            md = float((label_gain[lq] * discount[: len(lq)]).sum())
+            inv_max_dcg[q] = 1.0 / md if md > 0 else 0.0
+        self._doc_idx = jnp.asarray(doc_idx)
+        self._mask = jnp.asarray(doc_idx < num_data)
+        self._inv_max_dcg = jnp.asarray(inv_max_dcg, jnp.float32)
+        self._label_gain = jnp.asarray(label_gain, jnp.float32)
+        self._discount = jnp.asarray(discount, jnp.float32)
+        self._lab_pad = jnp.asarray(np.concatenate([lab, [0]]).astype(jnp.int32))
+        sigmoid = self.config.sigmoid
+        N = num_data
+
+        # chunk queries so the [q, D, D] pairwise block stays ~64MB
+        qc = max(1, min(Q, (1 << 24) // max(D * D, 1)))
+        while Q % qc:
+            qc -= 1
+        self._q_chunk = qc
+
+        @jax.jit
+        def f(score, lab_pad, doc_idx, mask, inv_max_dcg):
+            s1 = score[0]
+            s_pad = jnp.concatenate([s1, jnp.zeros(1, s1.dtype)])
+
+            def one_chunk(carry, args):
+                didx, msk, imd = args          # [qc, D], [qc, D], [qc]
+                sc = s_pad[didx]               # [qc, D]
+                lb = lab_pad[didx]             # [qc, D] int
+                sc = jnp.where(msk, sc, -jnp.inf)
+                order = jnp.argsort(-sc, axis=1)       # rank -> doc slot
+                sc_s = jnp.take_along_axis(sc, order, axis=1)
+                lb_s = jnp.take_along_axis(lb, order, axis=1)
+                msk_s = jnp.take_along_axis(msk, order, axis=1)
+                gain_s = self._label_gain[jnp.clip(lb_s, 0, label_gain.size - 1)]
+                disc = self._discount[None, : sc_s.shape[1]]
+                best = sc_s[:, 0]
+                cnt = msk_s.sum(axis=1)
+                worst = jnp.take_along_axis(
+                    sc_s, jnp.maximum(cnt - 1, 0)[:, None], axis=1)[:, 0]
+                # pairwise [qc, D(hi), D(lo)]
+                ds = sc_s[:, :, None] - sc_s[:, None, :]
+                valid = (msk_s[:, :, None] & msk_s[:, None, :]
+                         & (lb_s[:, :, None] > lb_s[:, None, :]))
+                dcg_gap = gain_s[:, :, None] - gain_s[:, None, :]
+                Dq = sc_s.shape[1]
+                paired_disc = jnp.abs(self._discount[None, :Dq, None]
+                                      - self._discount[None, None, :Dq])
+                delta = dcg_gap * paired_disc * imd[:, None, None]
+                norm = jnp.where((best != worst)[:, None, None],
+                                 0.01 + jnp.abs(ds), 1.0)
+                delta = delta / norm
+                p_lambda = 2.0 / (1.0 + jnp.exp(2.0 * sigmoid * ds))
+                p_hess = p_lambda * (2.0 - p_lambda)
+                p_lambda = jnp.where(valid, -p_lambda * delta, 0.0)
+                p_hess = jnp.where(valid, p_hess * 2.0 * delta, 0.0)
+                lam_s = p_lambda.sum(axis=2) - p_lambda.sum(axis=1)
+                hes_s = p_hess.sum(axis=2) + p_hess.sum(axis=1)
+                # unsort then scatter to flat [N]
+                g_flat, h_flat = carry
+                docs = jnp.take_along_axis(didx, order, axis=1)
+                g_flat = g_flat.at[docs.reshape(-1)].add(
+                    lam_s.reshape(-1), mode="drop")
+                h_flat = h_flat.at[docs.reshape(-1)].add(
+                    hes_s.reshape(-1), mode="drop")
+                return (g_flat, h_flat), None
+
+            g0 = jnp.zeros(N, s1.dtype)
+            h0 = jnp.zeros(N, s1.dtype)
+            Qn, D = doc_idx.shape
+            nchunk = Qn // qc
+            args = (doc_idx.reshape(nchunk, qc, D),
+                    mask.reshape(nchunk, qc, D),
+                    inv_max_dcg.reshape(nchunk, qc))
+            (g, h), _ = jax.lax.scan(one_chunk, (g0, h0), args)
+            if self.weights is not None:
+                g = g * self.weights
+                h = h * self.weights
+            return g[None, :], h[None, :]
+
+        self._f = f
+
+    def get_gradients(self, score):
+        return self._f(score, self._lab_pad, self._doc_idx, self._mask,
+                       self._inv_max_dcg)
+
+
+def create_objective(config: Config) -> Objective:
+    table = {
+        "regression": RegressionL2,
+        "regression_l1": RegressionL1,
+        "huber": RegressionHuber,
+        "fair": RegressionFair,
+        "poisson": RegressionPoisson,
+        "binary": BinaryLogloss,
+        "multiclass": MulticlassSoftmax,
+        "multiclassova": MulticlassOVA,
+        "lambdarank": LambdarankNDCG,
+    }
+    if config.objective not in table:
+        raise ValueError(f"unknown objective: {config.objective}")
+    return table[config.objective](config)
+
+
+def objective_from_model_string(s: str, config: Config) -> Objective:
+    """Recreate an objective from its model-file ToString() form
+    (objective_function.cpp:33-57)."""
+    toks = s.split()
+    name = toks[0]
+    kw = {}
+    for t in toks[1:]:
+        if ":" in t:
+            k, v = t.split(":", 1)
+            kw[k] = v
+    cfg = config
+    if "num_class" in kw:
+        cfg = cfg.with_updates(num_class=int(kw["num_class"]))
+    if "sigmoid" in kw:
+        cfg = cfg.with_updates(sigmoid=float(kw["sigmoid"]))
+    cfg = cfg.with_updates(objective=name)
+    return create_objective(cfg)

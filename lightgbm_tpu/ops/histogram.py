@@ -1,0 +1,187 @@
+"""Histogram construction — the hottest op in the framework.
+
+Replaces the reference's scalar CPU kernels (dense_bin.hpp:67-120) and the
+OpenCL local-memory-atomic kernels (ocl/histogram{16,64,256}.cl) with a
+TPU-idiomatic formulation: bins are one-hot encoded on the fly and reduced
+with a matmul so the accumulation runs on the MXU — there are no fast
+device atomics on TPU, but `one_hot(bins).T @ [grad, hess, 1]` is exactly a
+`[B, C] @ [C, 3]` contraction (SURVEY.md §7 "hard parts").
+
+Canonical output layout: `[F, 3, B]` float32 — (sum_grad, sum_hess, count)
+per feature per bin; B is the padded per-feature bin count.  Accumulation
+is fp32 (the reference GPU learner also uses single precision by default,
+gpu_tree_learner.h:79-83, and reports accuracy parity).
+
+Two implementations:
+- `hist_xla`: chunked one-hot einsum, pure XLA.  Used on CPU (tests) and as
+  the fallback.
+- `hist_pallas`: Pallas TPU kernel; grid over (feature, row-chunk), one-hot
+  built in VMEM and contracted immediately, fp32 accumulate in the output
+  block across row-chunks.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _pick_chunk(F: int, B: int, target_bytes: int = 1 << 26) -> int:
+    """Row-chunk size so the transient one-hot stays ~64MB."""
+    per_row = max(F * B * 2, 1)
+    c = max(256, target_bytes // per_row)
+    return int(2 ** int(np.floor(np.log2(c))))
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins_padded", "input_dtype"))
+def hist_xla(gb: jax.Array, vals: jax.Array, *, num_bins_padded: int,
+             input_dtype: str = "float32") -> jax.Array:
+    """Chunked one-hot matmul histogram.
+
+    Parameters
+    ----------
+    gb : [C, F] integer bin ids of the gathered rows (sentinel rows have
+         arbitrary bins but zero vals).
+    vals : [3, C] float32 rows (grad, hess, count-mask).
+    Returns [F, 3, B] float32.
+    """
+    C, F = gb.shape
+    B = num_bins_padded
+    dt = jnp.dtype(input_dtype)
+    chunk = min(_pick_chunk(F, B), C)
+    n_chunks = max(C // chunk, 1)
+    rem = C - n_chunks * chunk
+
+    def body(acc, args):
+        gbc, vc = args  # [chunk, F], [3, chunk]
+        oh = (gbc[:, :, None] == jax.lax.broadcasted_iota(
+            gbc.dtype, (1, 1, B), 2)).astype(dt)
+        acc = acc + jnp.einsum(
+            "sc,cfb->fsb", vc.astype(dt), oh,
+            preferred_element_type=jnp.float32)
+        return acc, None
+
+    acc0 = jnp.zeros((F, 3, B), jnp.float32)
+    main = (gb[: n_chunks * chunk].reshape(n_chunks, chunk, F),
+            vals[:, : n_chunks * chunk].reshape(3, n_chunks, chunk)
+            .transpose(1, 0, 2))
+    acc, _ = jax.lax.scan(body, acc0, main)
+    if rem:
+        acc, _ = body(acc, (gb[n_chunks * chunk:], vals[:, n_chunks * chunk:]))
+    return acc
+
+
+# ----------------------------------------------------------------------------
+# Pallas TPU kernel
+# ----------------------------------------------------------------------------
+
+def _hist_kernel(gb_ref, vals_ref, out_ref, *, B: int, input_dtype):
+    """One (feature, row-chunk) grid cell.
+
+    gb_ref: [1, Ck] int32 bins of feature f for this chunk
+    vals_ref: [8, Ck] float32 (grad, hess, mask, 5 pad rows)
+    out_ref: [1, 8, B] float32 accumulated across the chunk grid axis
+    """
+    from jax.experimental import pallas as pl
+
+    k = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    gb = gb_ref[0, :]                      # [Ck]
+    oh = (gb[:, None] == jax.lax.broadcasted_iota(jnp.int32, (1, B), 1)
+          ).astype(input_dtype)            # [Ck, B]
+    vals = vals_ref[:].astype(input_dtype)  # [8, Ck]
+    acc = jnp.dot(vals, oh, preferred_element_type=jnp.float32)  # [8, B]
+    out_ref[0, :, :] += acc
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins_padded", "input_dtype"))
+def hist_pallas(gb_t: jax.Array, vals8: jax.Array, *, num_bins_padded: int,
+                input_dtype: str = "bfloat16") -> jax.Array:
+    """Pallas histogram.  gb_t: [F, C] int32, vals8: [8, C] float32.
+
+    Returns [F, 3, B] float32.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    F, C = gb_t.shape
+    B = num_bins_padded
+    Ck = min(C, 2048)
+    if C % Ck:
+        # pad rows to a chunk multiple; padded slots have zero vals so they
+        # contribute nothing to any bin
+        pad = Ck - C % Ck
+        gb_t = jnp.pad(gb_t, ((0, 0), (0, pad)))
+        vals8 = jnp.pad(vals8, ((0, 0), (0, pad)))
+        C += pad
+    grid = (F, C // Ck)
+    dt = jnp.dtype(input_dtype)
+
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel, B=B, input_dtype=dt),
+        out_shape=jax.ShapeDtypeStruct((F, 8, B), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, Ck), lambda f, k: (f, k)),
+            pl.BlockSpec((8, Ck), lambda f, k: (0, k)),
+        ],
+        out_specs=pl.BlockSpec((1, 8, B), lambda f, k: (f, 0, 0)),
+    )(gb_t, vals8)
+    return out[:, :3, :]
+
+
+# ----------------------------------------------------------------------------
+# Public entry: gather + histogram
+# ----------------------------------------------------------------------------
+
+def histogram_from_indices(bins_t: jax.Array, grad_pad: jax.Array,
+                           hess_pad: jax.Array, idx: jax.Array, *,
+                           num_bins_padded: int, backend: str = "xla",
+                           input_dtype: str = "float32") -> jax.Array:
+    """hist [F, 3, B] over the rows named by `idx`.
+
+    bins_t : [N+1, F] integer bins, row N is the sentinel (any value).
+    grad_pad, hess_pad : [N+1] float32 with [N] == 0.
+    idx : [C] int32 row indices, padded with N.
+
+    The sentinel convention makes padded gathers branch-free: padded slots
+    contribute zero grad/hess/count (reference instead tracks explicit
+    leaf counts via DataPartition, data_partition.hpp:17-208).
+    """
+    N = grad_pad.shape[0] - 1
+    gb = jnp.take(bins_t, idx, axis=0)                  # [C, F]
+    g = jnp.take(grad_pad, idx)
+    h = jnp.take(hess_pad, idx)
+    mask = (idx < N).astype(jnp.float32)
+    if backend == "pallas":
+        C = idx.shape[0]
+        F = bins_t.shape[1]
+        vals8 = jnp.zeros((8, C), jnp.float32)
+        vals8 = vals8.at[0].set(g).at[1].set(h).at[2].set(mask)
+        return hist_pallas(gb.T.astype(jnp.int32), vals8,
+                           num_bins_padded=num_bins_padded,
+                           input_dtype=input_dtype)
+    vals = jnp.stack([g, h, mask])                      # [3, C]
+    return hist_xla(gb.astype(jnp.int32), vals,
+                    num_bins_padded=num_bins_padded, input_dtype=input_dtype)
+
+
+def histogram_full_masked(bins: jax.Array, grad: jax.Array, hess: jax.Array,
+                          mask: jax.Array, *, num_bins_padded: int,
+                          input_dtype: str = "float32") -> jax.Array:
+    """Full-scan masked histogram over ALL rows (no gather) — used by the
+    fused/distributed learner where row compaction is not shard-friendly.
+
+    bins: [F, N] (no sentinel), mask: [N] float32 0/1 row weights.
+    Returns [F, 3, B] float32.
+    """
+    vals = jnp.stack([grad * mask, hess * mask, mask])   # [3, N]
+    return hist_xla(bins.T.astype(jnp.int32), vals,
+                    num_bins_padded=num_bins_padded, input_dtype=input_dtype)

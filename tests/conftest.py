@@ -1,0 +1,61 @@
+"""Test configuration: run JAX on a virtual 8-device CPU mesh.
+
+Tests never need real TPU hardware; distributed learners are exercised on
+XLA's host-platform device simulator (SURVEY.md §4: the analog of the
+reference's CPU-OpenCL fake-GPU CI trick, .travis.yml:15-23).
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+# the axon TPU plugin in this image ignores JAX_PLATFORMS from the
+# environment; the config update is authoritative
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+REF_EXAMPLES = "/root/reference/examples"
+
+
+@pytest.fixture(scope="session")
+def binary_example():
+    from lightgbm_tpu.dataset import parse_text_file
+    X, y, _ = parse_text_file(f"{REF_EXAMPLES}/binary_classification/binary.train")
+    Xt, yt, _ = parse_text_file(f"{REF_EXAMPLES}/binary_classification/binary.test")
+    return X, y, Xt, yt
+
+
+@pytest.fixture(scope="session")
+def regression_example():
+    from lightgbm_tpu.dataset import parse_text_file
+    X, y, _ = parse_text_file(f"{REF_EXAMPLES}/regression/regression.train")
+    Xt, yt, _ = parse_text_file(f"{REF_EXAMPLES}/regression/regression.test")
+    return X, y, Xt, yt
+
+
+@pytest.fixture(scope="session")
+def multiclass_example():
+    from lightgbm_tpu.dataset import parse_text_file
+    X, y, _ = parse_text_file(
+        f"{REF_EXAMPLES}/multiclass_classification/multiclass.train")
+    Xt, yt, _ = parse_text_file(
+        f"{REF_EXAMPLES}/multiclass_classification/multiclass.test")
+    return X, y, Xt, yt
+
+
+@pytest.fixture(scope="session")
+def rank_example():
+    from lightgbm_tpu.dataset import parse_text_file
+    import numpy as np
+    X, y, _ = parse_text_file(f"{REF_EXAMPLES}/lambdarank/rank.train")
+    Xt, yt, _ = parse_text_file(f"{REF_EXAMPLES}/lambdarank/rank.test")
+    q = np.loadtxt(f"{REF_EXAMPLES}/lambdarank/rank.train.query", dtype=np.int64)
+    qt = np.loadtxt(f"{REF_EXAMPLES}/lambdarank/rank.test.query", dtype=np.int64)
+    return X, y, q, Xt, yt, qt

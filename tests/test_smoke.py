@@ -1,0 +1,43 @@
+"""End-to-end smoke: the reference test_engine.py metric-threshold harness
+(tests/python_package_test/test_engine.py:33-119)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def test_binary_logloss(binary_example):
+    X, y, Xt, yt = binary_example
+    params = {
+        "objective": "binary", "metric": "binary_logloss",
+        "num_leaves": 15, "learning_rate": 0.1, "verbose": 0,
+        "min_data_in_leaf": 10,
+    }
+    train = lgb.Dataset(X, y)
+    valid = lgb.Dataset(Xt, yt, reference=train)
+    evals_result = {}
+    bst = lgb.train(params, train, num_boost_round=50, valid_sets=[valid],
+                    evals_result=evals_result, verbose_eval=False)
+    # sklearn HistGradientBoosting reaches 0.519 with the same params; this
+    # dataset (Higgs-like physics features) is far harder than the
+    # sklearn breast-cancer data behind the reference's 0.15 threshold
+    loss = evals_result["valid_0"]["binary_logloss"][-1]
+    assert loss < 0.55
+    # predictions agree with recorded eval
+    pred = bst.predict(Xt)
+    p = np.clip(pred, 1e-15, 1 - 1e-15)
+    ll = -np.mean(np.where(yt > 0, np.log(p), np.log(1 - p)))
+    assert abs(ll - loss) < 1e-3
+
+
+def test_regression_l2(regression_example):
+    X, y, Xt, yt = regression_example
+    params = {"objective": "regression", "metric": "l2", "verbose": 0}
+    train = lgb.Dataset(X, y)
+    valid = lgb.Dataset(Xt, yt, reference=train)
+    evals_result = {}
+    lgb.train(params, train, num_boost_round=50, valid_sets=[valid],
+              evals_result=evals_result, verbose_eval=False)
+    mse = evals_result["valid_0"]["l2"][-1]
+    assert mse < 1.0  # labels in [0, 1]; reference threshold MSE < 16 on
+                      # a different scale
