@@ -1,0 +1,77 @@
+"""Benchmark harness — prints ONE JSON line.
+
+Workload: synthetic HIGGS-shaped binary classification (N×28 dense
+numerical features, the shape of the reference's headline benchmark,
+docs/GPU-Performance.md:77-84) trained with the north-star config
+(num_leaves=255, max_bin=255, lr=0.1, min_data_in_leaf=1,
+min_sum_hessian_in_leaf=100 — BASELINE.md).
+
+Metric: training seconds per boosting iteration on the default JAX
+backend (the real TPU chip under the driver).  `vs_baseline` is
+baseline_seconds_per_iter / our_seconds_per_iter (higher is better, >1
+means faster than baseline) against a measured run of the COMPILED
+REFERENCE binary on the same machine/data if `.bench/baseline.json`
+exists (see .bench/make_baseline.py), else 0.0 (no baseline measured).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
+ITERS = int(os.environ.get("BENCH_ITERS", 30))
+WARMUP = int(os.environ.get("BENCH_WARMUP", 2))
+LEAVES = int(os.environ.get("BENCH_LEAVES", 255))
+
+
+def synth_higgs(n, f=28, seed=42):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    w = rng.randn(f) / np.sqrt(f)
+    logits = X @ w + 0.5 * np.sin(X[:, 0] * 2.0) * X[:, 1] - 0.3 * X[:, 2] * X[:, 3]
+    y = (logits + rng.logistic(size=n) * 0.5 > 0).astype(np.float64)
+    return X.astype(np.float64), y
+
+
+def main():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import lightgbm_tpu as lgb
+
+    X, y = synth_higgs(ROWS)
+    params = {
+        "objective": "binary", "metric": "auc", "verbose": -1,
+        "num_leaves": LEAVES, "learning_rate": 0.1, "max_bin": 255,
+        "min_data_in_leaf": 1, "min_sum_hessian_in_leaf": 100.0,
+    }
+    train = lgb.Dataset(X, y)
+    bst = lgb.Booster(params, train)
+    for _ in range(WARMUP):          # compile + cache warm
+        bst.update()
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        bst.update()
+    dt = time.perf_counter() - t0
+    s_per_iter = dt / ITERS
+
+    base_file = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".bench", "baseline.json")
+    vs = 0.0
+    if os.path.exists(base_file):
+        with open(base_file) as f:
+            base = json.load(f)
+        if base.get("rows") == ROWS and base.get("num_leaves") == LEAVES:
+            vs = base["seconds_per_iter"] / s_per_iter
+
+    print(json.dumps({
+        "metric": f"synthetic-higgs {ROWS}x28 gbdt {LEAVES} leaves, "
+                  "255 bins: train seconds/iter",
+        "value": round(s_per_iter, 4),
+        "unit": "s/iter",
+        "vs_baseline": round(vs, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -26,7 +26,7 @@ import numpy as np
 
 from ..config import Config, default_metric_for_objective
 from ..dataset import Dataset
-from ..learner.serial import SerialTreeLearner
+from ..learner.fused import create_tree_learner
 from ..metrics import Metric, create_metric
 from ..objectives import Objective, create_objective, objective_from_model_string
 from ..tree import Tree, NUMERICAL_DECISION
@@ -68,7 +68,7 @@ class GBDT:
         self.objective = objective or create_objective(cfg)
         self.objective.init(train_set.metadata, self.num_data)
         self.K = self.objective.num_tree_per_iteration
-        self.learner = SerialTreeLearner(train_set, cfg)
+        self.learner = create_tree_learner(train_set, cfg)
         self.train_score = ScoreUpdater(
             self.learner.bins_t, self.num_data, self.K,
             train_set.metadata.init_score)
@@ -184,7 +184,9 @@ class GBDT:
             if tree.num_leaves > 1:
                 should_continue = True
                 tree.apply_shrinkage(self.shrinkage_rate)
-                if bag is None and leaf_id is not None:
+                if leaf_id is not None and (
+                        bag is None
+                        or getattr(self.learner, "full_leaf_id", False)):
                     self.train_score.add_tree_by_leaf_id(tree, leaf_id, k)
                 else:
                     self.train_score.add_tree(tree, k)

@@ -351,7 +351,8 @@ def check_param_conflict(cfg: Config) -> None:
         raise ValueError("num_class must be >= 2 for multiclass objectives")
     if cfg.boosting_type == "goss" and cfg.top_rate + cfg.other_rate > 1.0:
         raise ValueError("top_rate + other_rate must be <= 1.0 for GOSS")
-    if cfg.tree_learner not in ("serial", "feature", "data", "voting"):
+    if cfg.tree_learner not in ("serial", "feature", "data", "voting",
+                                "data2d"):
         raise ValueError(f"unknown tree_learner: {cfg.tree_learner}")
 
 

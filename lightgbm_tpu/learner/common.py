@@ -1,0 +1,33 @@
+"""Setup shared by the serial and fused tree learners — kept in one place
+so the two learners (which must grow identical trees,
+tests/test_parallel.py) cannot silently diverge."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..config import Config
+
+
+def make_split_kw(cfg: Config) -> tuple:
+    """Hashable (static-arg) split hyperparameters for ops.split.best_split
+    (reference feature_histogram.hpp:281-300 gain math inputs)."""
+    return tuple(sorted(dict(
+        lambda_l1=float(cfg.lambda_l1), lambda_l2=float(cfg.lambda_l2),
+        min_data_in_leaf=int(cfg.min_data_in_leaf),
+        min_sum_hessian_in_leaf=float(cfg.min_sum_hessian_in_leaf),
+        min_gain_to_split=float(cfg.min_gain_to_split)).items()))
+
+
+def padded_bin_count(max_num_bin: int) -> int:
+    """Bin axis padded to a lane-friendly multiple of 128."""
+    return max(128, int(128 * math.ceil(max_num_bin / 128)))
+
+
+def sentinel_bins_t(dataset) -> np.ndarray:
+    """[N+1, F] int32 transpose with a sentinel row at index N (bin 0) so
+    padded gathers are branch-free."""
+    bins_np = dataset.bins.astype(np.int32)
+    pad = np.zeros((dataset.num_features, 1), np.int32)
+    return np.concatenate([bins_np, pad], axis=1).T.copy()

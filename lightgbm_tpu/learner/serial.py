@@ -32,6 +32,7 @@ import numpy as np
 
 from ..config import Config
 from ..dataset import Dataset
+from .common import make_split_kw, padded_bin_count, sentinel_bins_t
 from ..ops.histogram import histogram_from_indices
 from ..ops.split import best_split, SplitResult
 from ..tree import Tree, NUMERICAL_DECISION, CATEGORICAL_DECISION
@@ -122,23 +123,16 @@ class SerialTreeLearner:
         self.config = config
         self.N = dataset.num_data
         self.F = dataset.num_features
-        # pad bin axis to a lane-friendly multiple of 128
-        self.B = max(128, int(128 * math.ceil(dataset.max_num_bin / 128)))
-        bins_np = dataset.bins.astype(np.int32)
-        pad = np.zeros((self.F, 1), np.int32)
-        self.bins = jnp.asarray(np.concatenate([bins_np, pad], axis=1))   # [F, N+1]
-        self.bins_t = jnp.asarray(np.concatenate([bins_np, pad], axis=1).T
-                                  .copy())                                 # [N+1, F]
+        self.B = padded_bin_count(dataset.max_num_bin)
+        bt = sentinel_bins_t(dataset)
+        self.bins = jnp.asarray(bt.T.copy())   # [F, N+1]
+        self.bins_t = jnp.asarray(bt)          # [N+1, F]
         self.num_bins_dev = jnp.asarray(dataset.num_bins)
         self.is_cat_dev = jnp.asarray(dataset.is_categorical)
         self.backend = ("pallas" if config.device_type == "tpu" and
                         jax.default_backend() == "tpu" else "xla")
         cfg = config
-        self.split_kw = tuple(sorted(dict(
-            lambda_l1=float(cfg.lambda_l1), lambda_l2=float(cfg.lambda_l2),
-            min_data_in_leaf=int(cfg.min_data_in_leaf),
-            min_sum_hessian_in_leaf=float(cfg.min_sum_hessian_in_leaf),
-            min_gain_to_split=float(cfg.min_gain_to_split)).items()))
+        self.split_kw = make_split_kw(cfg)
         self._feat_rng = np.random.RandomState(cfg.feature_fraction_seed)
         # memory guard: keep per-leaf histograms only if the full set fits
         hist_bytes = self.F * 3 * self.B * 4

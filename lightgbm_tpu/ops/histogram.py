@@ -55,13 +55,16 @@ def hist_xla(gb: jax.Array, vals: jax.Array, *, num_bins_padded: int,
     n_chunks = max(C // chunk, 1)
     rem = C - n_chunks * chunk
 
+    prec = (jax.lax.Precision.HIGHEST if dt == jnp.float32
+            else jax.lax.Precision.DEFAULT)
+
     def body(acc, args):
         gbc, vc = args  # [chunk, F], [3, chunk]
         oh = (gbc[:, :, None] == jax.lax.broadcasted_iota(
             gbc.dtype, (1, 1, B), 2)).astype(dt)
         acc = acc + jnp.einsum(
             "sc,cfb->fsb", vc.astype(dt), oh,
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32, precision=prec)
         return acc, None
 
     acc0 = jnp.zeros((F, 3, B), jnp.float32)
@@ -78,12 +81,19 @@ def hist_xla(gb: jax.Array, vals: jax.Array, *, num_bins_padded: int,
 # Pallas TPU kernel
 # ----------------------------------------------------------------------------
 
-def _hist_kernel(gb_ref, vals_ref, out_ref, *, B: int, input_dtype):
-    """One (feature, row-chunk) grid cell.
+FEATURE_GROUP = 8  # features per kernel block (TPU second-minor tiling)
 
-    gb_ref: [1, Ck] int32 bins of feature f for this chunk
+
+def _hist_kernel(gb_ref, vals_ref, out_ref, *, B: int, input_dtype):
+    """One (feature-group, row-chunk) grid cell.
+
+    gb_ref: [1, G, Ck] int32 bins of G features for this row chunk
     vals_ref: [8, Ck] float32 (grad, hess, mask, 5 pad rows)
-    out_ref: [1, 8, B] float32 accumulated across the chunk grid axis
+    out_ref: [1, G, 8, B] float32 accumulated across the chunk grid axis
+
+    TPU block shapes need the last two dims (8|16|32, 128)-aligned
+    (pallas guide "tiling"): grouping G=8 features per block keeps every
+    ref legal, and the G one-hot matmuls unroll inside the kernel.
     """
     from jax.experimental import pallas as pl
 
@@ -93,12 +103,17 @@ def _hist_kernel(gb_ref, vals_ref, out_ref, *, B: int, input_dtype):
     def _init():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    gb = gb_ref[0, :]                      # [Ck]
-    oh = (gb[:, None] == jax.lax.broadcasted_iota(jnp.int32, (1, B), 1)
-          ).astype(input_dtype)            # [Ck, B]
-    vals = vals_ref[:].astype(input_dtype)  # [8, Ck]
-    acc = jnp.dot(vals, oh, preferred_element_type=jnp.float32)  # [8, B]
-    out_ref[0, :, :] += acc
+    vals = vals_ref[:].astype(input_dtype)      # [8, Ck]
+    # f32 inputs get full-precision (3-pass) MXU matmuls; bf16 runs fast
+    prec = (jax.lax.Precision.HIGHEST if input_dtype == jnp.float32
+            else jax.lax.Precision.DEFAULT)
+    G = gb_ref.shape[1]
+    for g in range(G):
+        gb = gb_ref[0, g, :]                    # [Ck]
+        oh = (gb[:, None] == jax.lax.broadcasted_iota(
+            jnp.int32, (1, B), 1)).astype(input_dtype)   # [Ck, B]
+        out_ref[0, g, :, :] += jnp.dot(
+            vals, oh, preferred_element_type=jnp.float32, precision=prec)
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins_padded", "input_dtype"))
@@ -109,10 +124,10 @@ def hist_pallas(gb_t: jax.Array, vals8: jax.Array, *, num_bins_padded: int,
     Returns [F, 3, B] float32.
     """
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
     F, C = gb_t.shape
     B = num_bins_padded
+    G = FEATURE_GROUP
     Ck = min(C, 2048)
     if C % Ck:
         # pad rows to a chunk multiple; padded slots have zero vals so they
@@ -121,20 +136,24 @@ def hist_pallas(gb_t: jax.Array, vals8: jax.Array, *, num_bins_padded: int,
         gb_t = jnp.pad(gb_t, ((0, 0), (0, pad)))
         vals8 = jnp.pad(vals8, ((0, 0), (0, pad)))
         C += pad
-    grid = (F, C // Ck)
+    Fg = G * ((F + G - 1) // G)
+    if Fg > F:
+        gb_t = jnp.pad(gb_t, ((0, Fg - F), (0, 0)))
+    gb_g = gb_t.reshape(Fg // G, G, C)
+    grid = (Fg // G, C // Ck)
     dt = jnp.dtype(input_dtype)
 
     out = pl.pallas_call(
         functools.partial(_hist_kernel, B=B, input_dtype=dt),
-        out_shape=jax.ShapeDtypeStruct((F, 8, B), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((Fg // G, G, 8, B), jnp.float32),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, Ck), lambda f, k: (f, k)),
+            pl.BlockSpec((1, G, Ck), lambda f, k: (f, 0, k)),
             pl.BlockSpec((8, Ck), lambda f, k: (0, k)),
         ],
-        out_specs=pl.BlockSpec((1, 8, B), lambda f, k: (f, 0, 0)),
-    )(gb_t, vals8)
-    return out[:, :3, :]
+        out_specs=pl.BlockSpec((1, G, 8, B), lambda f, k: (f, 0, 0, 0)),
+    )(gb_g, vals8)
+    return out.reshape(Fg, 8, B)[:F, :3, :]
 
 
 # ----------------------------------------------------------------------------
