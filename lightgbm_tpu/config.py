@@ -239,6 +239,15 @@ class Config:
     top_rate: float = 0.2
     other_rate: float = 0.1
     tree_learner: str = "serial"
+    # TPU extension: growth scheduling. "exact" = one split at a time
+    # (reference leaf-wise semantics); "rounds" = batched rounds (all
+    # splittable leaves per round, top-gain-capped — the MXU-efficient
+    # schedule); "auto" = rounds on TPU, exact elsewhere.
+    tree_growth: str = "auto"
+    # histogram matmul operand precision: float32 (exact, 3-pass MXU) or
+    # bfloat16 (fast).  The reference GPU learner has the same dial as
+    # gpu_use_dp (config.h:206, single vs double) with single the default.
+    histogram_dtype: str = "float32"
 
     # -- network (config.h:245-252)
     num_machines: int = 1
@@ -354,6 +363,8 @@ def check_param_conflict(cfg: Config) -> None:
     if cfg.tree_learner not in ("serial", "feature", "data", "voting",
                                 "data2d"):
         raise ValueError(f"unknown tree_learner: {cfg.tree_learner}")
+    if cfg.tree_growth not in ("auto", "exact", "rounds"):
+        raise ValueError(f"unknown tree_growth: {cfg.tree_growth}")
 
 
 def parse_config_file(path: str) -> Dict[str, str]:

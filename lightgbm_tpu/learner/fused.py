@@ -263,11 +263,39 @@ def build_tree(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
     return st[-1], st[0]
 
 
-def tree_arrays_to_host(arrs: TreeArrays, dataset: Dataset,
-                        max_leaves: int) -> Tree:
+@jax.jit
+def pack_tree_arrays(arrs: TreeArrays) -> jax.Array:
+    """Flatten TreeArrays into ONE f32 vector so the host fetches a single
+    transfer (per-array fetches cost a device round-trip each — ruinous on
+    remote-attached TPUs).  All int fields fit f32 exactly (< 2^24)."""
+    return jnp.concatenate(
+        [jnp.ravel(x).astype(jnp.float32) for x in arrs]
+        + [jnp.zeros(1, jnp.float32)])
+
+
+def unpack_tree_arrays(vec: np.ndarray, L: int) -> TreeArrays:
+    sizes = [L - 1] * 8 + [L] * 3 + [1]
+    dts = ([np.int32, np.int32, bool, np.int32, np.int32, np.float32,
+            np.float32, np.float32, np.float32, np.float32, np.int32,
+            np.int32])
+    out, off = [], 0
+    for sz, dt in zip(sizes, dts):
+        part = vec[off:off + sz]
+        out.append(part.astype(dt) if dt != bool else part > 0.5)
+        off += sz
+    out[-1] = out[-1][0]
+    return TreeArrays(*out)
+
+
+def tree_arrays_to_host(arrs, dataset: Dataset, max_leaves: int) -> Tree:
     """Rehydrate the host Tree model (real feature ids + real-valued
-    thresholds via the BinMappers) from device TreeArrays."""
-    a = jax.tree_util.tree_map(np.asarray, arrs)
+    thresholds via the BinMappers) from device TreeArrays.  Accepts either
+    a TreeArrays of device arrays or an already-unpacked numpy TreeArrays."""
+    if isinstance(arrs.num_leaves, jax.Array):
+        a = unpack_tree_arrays(np.asarray(pack_tree_arrays(arrs)),
+                               max_leaves)
+    else:
+        a = arrs
     n = int(a.num_leaves)
     t = Tree(max_leaves)
     t.num_leaves = n
@@ -435,15 +463,39 @@ def make_mesh(tree_learner: str, num_machines: int = 0
 
 
 def create_tree_learner(dataset: Dataset, config: Config):
-    """Factory (reference tree_learner.cpp:9-33): serial → host-loop
-    gather learner; data/feature/voting/data2d → fused SPMD learner."""
+    """Factory (reference tree_learner.cpp:9-33).
+
+    tree_learner picks the PARALLELISM (serial / data / feature / voting /
+    data2d → mesh axes); tree_growth picks the SCHEDULE:
+    - "exact": strict one-split-at-a-time leaf-wise.  On CPU this is the
+      host-loop gather learner (learner/serial.py); on TPU it is the fused
+      single-split builder (no per-split host syncs).
+    - "rounds": batched rounds (learner/rounds.py) — the MXU-efficient
+      schedule; equals leaf-wise whenever the num_leaves cap doesn't bind.
+    - "auto": rounds on TPU, exact elsewhere.
+    """
     lt = getattr(config, "tree_learner", "serial")
+    growth = getattr(config, "tree_growth", "auto")
+    on_tpu = jax.default_backend() == "tpu"
+    if growth == "auto":
+        growth = "rounds" if on_tpu else "exact"
+
+    mesh = None
     if lt in ("data", "feature", "voting", "data2d"):
         mesh = make_mesh(lt, getattr(config, "num_machines", 0))
-        if mesh is not None:
-            return FusedTreeLearner(dataset, config, mesh)
-        import warnings
-        warnings.warn(f"tree_learner={lt} requested but only one device "
-                      "is visible; falling back to serial")
+        if mesh is None:
+            import warnings
+            warnings.warn(f"tree_learner={lt} requested but only one device "
+                          "is visible; running single-device")
+
+    feature_sharded = (mesh is not None and dict(
+        zip(mesh.axis_names, mesh.devices.shape)).get("feature", 1) > 1)
+    if growth == "rounds" and not feature_sharded:
+        from .rounds import RoundsTreeLearner
+        return RoundsTreeLearner(dataset, config, mesh)
+    if mesh is not None:
+        return FusedTreeLearner(dataset, config, mesh)
+    if on_tpu:
+        return FusedTreeLearner(dataset, config, None)
     from .serial import SerialTreeLearner
     return SerialTreeLearner(dataset, config)

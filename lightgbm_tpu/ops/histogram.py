@@ -156,6 +156,225 @@ def hist_pallas(gb_t: jax.Array, vals8: jax.Array, *, num_bins_padded: int,
     return out.reshape(Fg, 8, B)[:F, :3, :]
 
 
+def _hist_kernel_ml(gb_ref, vals_ref, out_ref, *, B: int, input_dtype):
+    """Multi-leaf variant: vals carries M = 3·K channel rows (grad, hess,
+    mask for K leaves), so one pass over the rows histograms K leaves at
+    once — the M dimension of the MXU matmul is what the per-leaf version
+    wastes (M=8, ~6% utilization); at M=128 the systolic array is full.
+
+    gb_ref: [1, G, Ck] int32 ; vals_ref: [M, Ck] f32 ; out_ref: [1, G, M, B]
+    """
+    from jax.experimental import pallas as pl
+
+    k = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    vals = vals_ref[:].astype(input_dtype)
+    prec = (jax.lax.Precision.HIGHEST if input_dtype == jnp.float32
+            else jax.lax.Precision.DEFAULT)
+    G = gb_ref.shape[1]
+    for g in range(G):
+        gb = gb_ref[0, g, :]
+        oh = (gb[:, None] == jax.lax.broadcasted_iota(
+            jnp.int32, (1, B), 1)).astype(input_dtype)
+        out_ref[0, g, :, :] += jnp.dot(
+            vals, oh, preferred_element_type=jnp.float32, precision=prec)
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins_padded", "input_dtype"))
+def hist_pallas_multileaf(gb_t: jax.Array, vals: jax.Array, *,
+                          num_bins_padded: int,
+                          input_dtype: str = "bfloat16") -> jax.Array:
+    """Multi-leaf pallas histogram.  gb_t: [F, C] int, vals: [M, C] f32
+    (M a multiple of 8, ≤ 128).  Returns [F, M, B] f32."""
+    from jax.experimental import pallas as pl
+
+    F, C = gb_t.shape
+    M = vals.shape[0]
+    B = num_bins_padded
+    G = FEATURE_GROUP
+    Ck = min(C, 2048)
+    if C % Ck:
+        pad = Ck - C % Ck
+        gb_t = jnp.pad(gb_t, ((0, 0), (0, pad)))
+        vals = jnp.pad(vals, ((0, 0), (0, pad)))
+        C += pad
+    Fg = G * ((F + G - 1) // G)
+    if Fg > F:
+        gb_t = jnp.pad(gb_t, ((0, Fg - F), (0, 0)))
+    gb_g = gb_t.reshape(Fg // G, G, C).astype(jnp.int32)
+    grid = (Fg // G, C // Ck)
+    dt = jnp.dtype(input_dtype)
+
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel_ml, B=B, input_dtype=dt),
+        out_shape=jax.ShapeDtypeStruct((Fg // G, G, M, B), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, G, Ck), lambda f, k: (f, 0, k)),
+            pl.BlockSpec((M, Ck), lambda f, k: (0, k)),
+        ],
+        out_specs=pl.BlockSpec((1, G, M, B), lambda f, k: (f, 0, 0, 0)),
+    )(gb_g, vals)
+    return out.reshape(Fg, M, B)[:F]
+
+
+def hist_multileaf_xla(gb_t: jax.Array, vals: jax.Array, *,
+                       num_bins_padded: int,
+                       input_dtype: str = "float32") -> jax.Array:
+    """XLA fallback for the multi-leaf histogram (CPU tests / non-TPU).
+    gb_t: [F, C] int, vals: [M, C] f32 → [F, M, B] f32."""
+    B = num_bins_padded
+    dt = jnp.dtype(input_dtype)
+    prec = (jax.lax.Precision.HIGHEST if dt == jnp.float32
+            else jax.lax.Precision.DEFAULT)
+    C = gb_t.shape[1]
+    chunk = min(C, 1 << 16)
+    n_chunks = (C + chunk - 1) // chunk
+    if C % chunk:
+        pad = chunk * n_chunks - C
+        gb_t = jnp.pad(gb_t, ((0, 0), (0, pad)))
+        vals = jnp.pad(vals, ((0, 0), (0, pad)))
+
+    def body(acc, args):
+        gbc, vc = args  # [F, chunk], [M, chunk]
+        oh = (gbc[:, :, None] == jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, B), 2)).astype(dt)
+        return acc + jnp.einsum("mc,fcb->fmb", vc.astype(dt), oh,
+                                preferred_element_type=jnp.float32,
+                                precision=prec), None
+
+    F = gb_t.shape[0]
+    M = vals.shape[0]
+    acc0 = jnp.zeros((F, M, B), jnp.float32)
+    gbs = gb_t.reshape(F, n_chunks, chunk).transpose(1, 0, 2).astype(jnp.int32)
+    vs = vals.reshape(M, n_chunks, chunk).transpose(1, 0, 2)
+    acc, _ = jax.lax.scan(body, acc0, (gbs, vs))
+    return acc
+
+
+def hist_multileaf(gb_t: jax.Array, vals: jax.Array, *, num_bins_padded: int,
+                   backend: str = "xla",
+                   input_dtype: str = "float32") -> jax.Array:
+    if backend == "pallas":
+        return hist_pallas_multileaf(gb_t, vals,
+                                     num_bins_padded=num_bins_padded,
+                                     input_dtype=input_dtype)
+    return hist_multileaf_xla(gb_t, vals, num_bins_padded=num_bins_padded,
+                              input_dtype=input_dtype)
+
+
+def _hist_kernel_masked(sl_ref, gb_ref, lid_ref, gh_ref, out_ref, *,
+                        B: int, K: int, input_dtype):
+    """Multi-leaf histogram with the leaf masks built in VMEM.
+
+    sl_ref : [Kp, 128] int32 — small-leaf id per slot, replicated across
+             lanes (-1 for empty slots, matches nothing)
+    gb_ref : [1, G, Ck] int32 ; lid_ref: [1, Ck] int32 leaf id per row
+    gh_ref : [8, Ck] f32 rows (grad·rm, hess·rm, rm, pad…)
+    out_ref: [1, G, Mp, B] f32 — rows [0:K)=grad, [K:2K)=hess, [2K:3K)=count
+
+    Fusing the mask construction here avoids materializing the [3K, N]
+    values matrix in HBM per chunk (the XLA-level formulation round-trips
+    ~0.5 GB per histogram pass at N=1M).
+    """
+    from jax.experimental import pallas as pl
+
+    k = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    lid = lid_ref[0, :]                                  # [Ck]
+    sl = sl_ref[:K, 0:1]                                 # [K, 1]
+    m = (lid[None, :] == sl).astype(input_dtype)         # [K, Ck]
+    g = gh_ref[0:1, :].astype(input_dtype)
+    h = gh_ref[1:2, :].astype(input_dtype)
+    rm = gh_ref[2:3, :].astype(input_dtype)
+    vals = jnp.concatenate([m * g, m * h, m * rm], axis=0)   # [3K, Ck]
+    Mp = out_ref.shape[2]
+    if Mp > 3 * K:
+        vals = jnp.concatenate(
+            [vals, jnp.zeros((Mp - 3 * K, vals.shape[1]), input_dtype)],
+            axis=0)
+    prec = (jax.lax.Precision.HIGHEST if input_dtype == jnp.float32
+            else jax.lax.Precision.DEFAULT)
+    G = gb_ref.shape[1]
+    for g_ in range(G):
+        gb = gb_ref[0, g_, :]
+        oh = (gb[:, None] == jax.lax.broadcasted_iota(
+            jnp.int32, (1, B), 1)).astype(input_dtype)
+        out_ref[0, g_, :, :] += jnp.dot(
+            vals, oh, preferred_element_type=jnp.float32, precision=prec)
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins_padded", "backend",
+                                             "input_dtype"))
+def hist_multileaf_masked(gb_t: jax.Array, lid: jax.Array, gh8: jax.Array,
+                          sl: jax.Array, *, num_bins_padded: int,
+                          backend: str = "xla",
+                          input_dtype: str = "float32") -> jax.Array:
+    """Histogram K leaves in one pass, masks built on the fly.
+
+    gb_t: [F, C] int bins; lid: [C] int32 leaf ids; gh8: [8, C] f32
+    (grad·rm, hess·rm, rm, pads); sl: [K] int32 leaf ids to histogram
+    (-1 = empty slot).  Returns [K, F, 3, B] f32.
+    """
+    from jax.experimental import pallas as pl
+
+    F, C = gb_t.shape
+    K = sl.shape[0]
+    B = num_bins_padded
+
+    if backend != "pallas":
+        m = (lid[None, :] == sl[:, None]).astype(jnp.float32)
+        vals = jnp.concatenate(
+            [m * gh8[0:1], m * gh8[1:2], m * gh8[2:3]], axis=0)  # [3K, C]
+        h = hist_multileaf_xla(gb_t, vals, num_bins_padded=B,
+                               input_dtype=input_dtype)          # [F, 3K, B]
+        return jnp.stack([h[:, :K], h[:, K:2 * K], h[:, 2 * K:3 * K]],
+                         axis=2).transpose(1, 0, 2, 3)
+
+    G = FEATURE_GROUP
+    Ck = min(C, 2048)
+    if C % Ck:
+        pad = Ck - C % Ck
+        gb_t = jnp.pad(gb_t, ((0, 0), (0, pad)))
+        lid = jnp.pad(lid, (0, pad), constant_values=-2)
+        gh8 = jnp.pad(gh8, ((0, 0), (0, pad)))
+        C += pad
+    Fg = G * ((F + G - 1) // G)
+    if Fg > F:
+        gb_t = jnp.pad(gb_t, ((0, Fg - F), (0, 0)))
+    gb_g = gb_t.reshape(Fg // G, G, C).astype(jnp.int32)
+    Mp = 8 * ((3 * K + 7) // 8)
+    Kp = 8 * ((K + 7) // 8)
+    sl2 = jnp.broadcast_to(jnp.pad(sl, (0, Kp - K),
+                                   constant_values=-1)[:, None], (Kp, 128))
+    grid = (Fg // G, C // Ck)
+    dt = jnp.dtype(input_dtype)
+
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel_masked, B=B, K=K, input_dtype=dt),
+        out_shape=jax.ShapeDtypeStruct((Fg // G, G, Mp, B), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((Kp, 128), lambda f, k: (0, 0)),
+            pl.BlockSpec((1, G, Ck), lambda f, k: (f, 0, k)),
+            pl.BlockSpec((1, Ck), lambda f, k: (0, k)),
+            pl.BlockSpec((8, Ck), lambda f, k: (0, k)),
+        ],
+        out_specs=pl.BlockSpec((1, G, Mp, B), lambda f, k: (f, 0, 0, 0)),
+    )(sl2, gb_g, lid[None, :], gh8)
+    h = out.reshape(Fg, Mp, B)[:F]                       # [F, Mp, B]
+    return jnp.stack([h[:, :K], h[:, K:2 * K], h[:, 2 * K:3 * K]],
+                     axis=2).transpose(1, 0, 2, 3)
+
+
 # ----------------------------------------------------------------------------
 # Public entry: gather + histogram
 # ----------------------------------------------------------------------------

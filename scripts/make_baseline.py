@@ -1,0 +1,78 @@
+"""Measure the reference LightGBM binary on the bench workload and record
+the baseline that bench.py's `vs_baseline` compares against.
+
+The reference is compiled from /root/reference (v2.0-era sources need
+forced <limits>/<cstdint> includes under modern gcc):
+
+    mkdir -p .bench/ref_build && cd .bench/ref_build
+    cmake /root/reference -DCMAKE_BUILD_TYPE=Release \
+          -DCMAKE_POLICY_VERSION_MINIMUM=3.5 \
+          -DCMAKE_CXX_FLAGS="-include limits -include cstdint -w"
+    make -j && mv /root/reference/lightgbm /root/reference/lib_lightgbm.so ../
+    (the reference CMakeLists links into its own source dir;
+     move the artifacts out immediately)
+
+Then:  python scripts/make_baseline.py
+"""
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(ROOT, ".bench")
+sys.path.insert(0, ROOT)
+
+from bench import ROWS, ITERS, LEAVES, synth_higgs  # noqa: E402
+
+
+def main():
+    binary = os.path.join(BENCH, "lightgbm")
+    if not os.path.exists(binary):
+        raise SystemExit(f"reference binary not found at {binary}; "
+                         "see module docstring for the build recipe")
+    os.makedirs(os.path.join(BENCH, "data"), exist_ok=True)
+    train_f = os.path.join(BENCH, "data", f"higgs_{ROWS}.train")
+    if not os.path.exists(train_f):
+        X, y = synth_higgs(ROWS)
+        np.savetxt(train_f, np.column_stack([y, X]), fmt="%.6g",
+                   delimiter="\t")
+    conf = os.path.join(BENCH, "baseline.conf")
+    with open(conf, "w") as f:
+        f.write(f"""task = train
+objective = binary
+data = {train_f}
+num_trees = {ITERS}
+learning_rate = 0.1
+num_leaves = {LEAVES}
+max_bin = 255
+min_data_in_leaf = 1
+min_sum_hessian_in_leaf = 100
+output_model = {BENCH}/baseline_model.txt
+""")
+    t0 = time.perf_counter()
+    out = subprocess.run([binary, f"config={conf}"], capture_output=True,
+                         text=True, cwd=BENCH)
+    total = time.perf_counter() - t0
+    # per-iteration seconds from the reference's own elapsed log lines
+    times = [float(m.group(1)) for m in re.finditer(
+        r"([\d.]+) seconds elapsed, finished iteration", out.stdout)]
+    if len(times) >= 2:
+        s_per_iter = (times[-1] - times[0]) / (len(times) - 1)
+    else:
+        s_per_iter = total / ITERS
+    base = {"rows": ROWS, "num_leaves": LEAVES, "iters": ITERS,
+            "seconds_per_iter": round(s_per_iter, 4),
+            "total_seconds_incl_load": round(total, 2),
+            "source": "reference binary (1-thread CPU, this machine)"}
+    with open(os.path.join(BENCH, "baseline.json"), "w") as f:
+        json.dump(base, f, indent=1)
+    print(json.dumps(base))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""Batched-rounds learner (learner/rounds.py): equivalence with exact
+leaf-wise growth when the num_leaves cap does not bind, sharded and not."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.config import config_from_params
+from lightgbm_tpu.dataset import Dataset as RawDataset
+from lightgbm_tpu.learner.serial import SerialTreeLearner
+from lightgbm_tpu.learner.rounds import RoundsTreeLearner
+from lightgbm_tpu.learner.fused import make_mesh
+
+
+@pytest.fixture(scope="module")
+def problem():
+    rng = np.random.RandomState(7)
+    X = rng.randn(4000, 12)
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] + 0.1 * rng.randn(4000) > 0
+         ).astype(np.float64)
+    cfg = config_from_params({
+        "objective": "binary", "num_leaves": 63, "min_data_in_leaf": 50,
+        "verbose": -1, "min_gain_to_split": 0.1})
+    ds = RawDataset(X, y, config=cfg)
+    p = 0.5
+    g = jnp.asarray(((p - y) * 2).astype(np.float32))
+    h = jnp.asarray(np.full(len(y), p * (1 - p) * 2, np.float32))
+    return ds, cfg, g, h
+
+
+def _splits(t):
+    return sorted(zip(t.split_feature_inner[: t.num_leaves - 1],
+                      t.threshold_in_bin[: t.num_leaves - 1]))
+
+
+def test_rounds_equals_exact_when_cap_loose(problem):
+    ds, cfg, g, h = problem
+    ts, _ = SerialTreeLearner(ds, cfg).train(g, h)
+    tr, lid = RoundsTreeLearner(ds, cfg, None).train(g, h)
+    assert tr.num_leaves == ts.num_leaves
+    assert _splits(tr) == _splits(ts)
+    np.testing.assert_allclose(
+        np.sort(tr.leaf_value[: tr.num_leaves]),
+        np.sort(ts.leaf_value[: ts.num_leaves]), rtol=1e-4, atol=1e-6)
+    counts = np.bincount(np.asarray(lid), minlength=tr.num_leaves)
+    np.testing.assert_array_equal(counts, tr.leaf_count[: tr.num_leaves])
+
+
+def test_rounds_sharded_matches_unsharded(problem):
+    ds, cfg, g, h = problem
+    tr, _ = RoundsTreeLearner(ds, cfg, None).train(g, h)
+    mesh = make_mesh("data")
+    tm, _ = RoundsTreeLearner(ds, cfg, mesh).train(g, h)
+    assert tm.num_leaves == tr.num_leaves
+    assert _splits(tm) == _splits(tr)
+
+
+def test_rounds_respects_num_leaves_cap(problem):
+    ds, cfg, g, h = problem
+    cfg2 = config_from_params({
+        "objective": "binary", "num_leaves": 8, "min_data_in_leaf": 50,
+        "verbose": -1})
+    tr, _ = RoundsTreeLearner(ds, cfg2, None).train(g, h)
+    assert 1 < tr.num_leaves <= 8
