@@ -18,11 +18,14 @@ from .engine import train, cv
 from .callback import (early_stopping, print_evaluation, record_evaluation,
                        reset_parameter)
 from .sklearn import LGBMModel, LGBMRegressor, LGBMClassifier, LGBMRanker
+from .plotting import (plot_importance, plot_metric, plot_tree,
+                       create_tree_digraph)
 
 __all__ = [
     "Config", "config_from_params", "PARAM_ALIASES", "Metadata", "Tree",
     "GBDT", "create_boosting", "Dataset", "Booster", "LightGBMError",
     "train", "cv", "early_stopping", "print_evaluation", "record_evaluation",
     "reset_parameter", "LGBMModel", "LGBMRegressor", "LGBMClassifier",
-    "LGBMRanker",
+    "LGBMRanker", "plot_importance", "plot_metric", "plot_tree",
+    "create_tree_digraph",
 ]

@@ -1,0 +1,7 @@
+"""`python -m lightgbm_tpu config=train.conf` — the CLI entry point
+(reference src/main.cpp:4-22)."""
+import sys
+
+from .application import main
+
+sys.exit(main())

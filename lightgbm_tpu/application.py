@@ -1,0 +1,165 @@
+"""CLI application: `python -m lightgbm_tpu key=value… [config=train.conf]`.
+
+Mirrors the reference Application (/root/reference/src/application/
+application.cpp:46-248, main.cpp): parse key=value argv + config file,
+task=train → load data/valid sets, boost with per-iteration metric output
+and wall-clock logging, save model; task=predict → batch-score a data file
+to output_result.  The reference examples' train.conf/predict.conf run
+unmodified.
+"""
+from __future__ import annotations
+
+import sys
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from .basic import Booster, Dataset, LightGBMError
+from .boosting.gbdt import create_boosting
+from .config import (Config, check_param_conflict, config_from_params,
+                     parse_cli_args)
+from .dataset import Dataset as RawDataset, parse_text_file
+
+
+def _log(cfg: Config, msg: str) -> None:
+    if cfg.verbose >= 1:
+        print(f"[LightGBM-TPU] [Info] {msg}", flush=True)
+
+
+def _label_idx(cfg: Config) -> int:
+    """label_column → column index (dataset_loader.cpp:22-157 semantics:
+    a bare index, or `name:<col>` which needs a header)."""
+    if not cfg.label_column:
+        return 0
+    if cfg.label_column.startswith("name:"):
+        raise LightGBMError(
+            "label_column=name:<col> requires has_header=true data; "
+            "name-based selection is not supported for prediction input")
+    try:
+        return int(cfg.label_column)
+    except ValueError:
+        raise LightGBMError(
+            f"invalid label_column: {cfg.label_column!r}") from None
+
+
+class Application:
+    def __init__(self, argv: List[str]):
+        params = parse_cli_args(argv)
+        if not params:
+            raise LightGBMError(
+                "no parameters given; usage: python -m lightgbm_tpu "
+                "config=train.conf [key=value ...]")
+        self.params = params
+        self.config = config_from_params(params)
+        check_param_conflict(self.config)
+
+    def run(self) -> None:
+        if self.config.task == "train":
+            self._train()
+        elif self.config.task in ("predict", "prediction", "test"):
+            self._predict()
+        else:
+            raise LightGBMError(f"unknown task: {self.config.task}")
+
+    # ------------------------------------------------------------------
+    def _train(self) -> None:
+        cfg = self.config
+        if not cfg.data:
+            raise LightGBMError("no training data: set data=<file>")
+        t0 = time.time()
+        train_raw = RawDataset.from_file(cfg.data, cfg)
+        _log(cfg, f"finished loading data in {time.time() - t0:.6f} seconds")
+        _log(cfg, f"number of data: {train_raw.num_data}, number of "
+                  f"features: {train_raw.num_features}")
+
+        gbdt = create_boosting(cfg, cfg.input_model)
+        from .objectives import create_objective
+        objective = create_objective(cfg)
+        gbdt.reset_training_data(train_raw, objective)
+        for i, vpath in enumerate(cfg.valid_data):
+            vraw = RawDataset.from_file(vpath, cfg, reference=train_raw)
+            gbdt.add_valid(vraw, f"valid_{i + 1}")
+
+        start = time.time()
+        for it in range(cfg.num_iterations):
+            stop = gbdt.train_one_iter(None, None, is_eval=False)
+            printing = (cfg.verbose >= 1 and cfg.metric_freq > 0
+                        and (it + 1) % cfg.metric_freq == 0)
+            valid_res = (gbdt.eval_valid()
+                         if printing or cfg.early_stopping_round > 0 else [])
+            if cfg.early_stopping_round > 0:
+                stop = stop or gbdt.eval_and_check_early_stopping(valid_res)
+            if printing:
+                for name, metric_name, val, _ in (
+                        gbdt.eval_train() if cfg.is_training_metric else []):
+                    _log(cfg, f"Iteration:{it + 1}, {name} {metric_name} : "
+                              f"{val:g}")
+                for name, metric_name, val, _ in valid_res:
+                    _log(cfg, f"Iteration:{it + 1}, {name} {metric_name} : "
+                              f"{val:g}")
+            _log(cfg, f"{time.time() - start:.6f} seconds elapsed, finished "
+                      f"iteration {it + 1}")
+            if stop:
+                _log(cfg, "early stopping")
+                break
+        gbdt.save_model_to_file(cfg.output_model)
+        _log(cfg, f"finished training, model saved to {cfg.output_model}")
+
+    # ------------------------------------------------------------------
+    def _predict(self) -> None:
+        cfg = self.config
+        if not cfg.data:
+            raise LightGBMError("no prediction data: set data=<file>")
+        if not cfg.input_model:
+            raise LightGBMError("no model: set input_model=<file>")
+        bst = Booster(model_file=cfg.input_model)
+        predictor = Predictor(bst, raw_score=cfg.is_predict_raw_score,
+                              leaf_index=cfg.is_predict_leaf_index,
+                              num_iteration=cfg.num_iteration_predict)
+        predictor.predict_file(cfg.data, cfg.output_result,
+                               has_header=cfg.has_header,
+                               label_idx=_label_idx(cfg))
+        _log(cfg, f"finished prediction, results saved to "
+                  f"{cfg.output_result}")
+
+
+class Predictor:
+    """Batch file prediction (reference predictor.hpp:24-159): parse the
+    input file, score every row, write one prediction per line."""
+
+    def __init__(self, booster: Booster, raw_score: bool = False,
+                 leaf_index: bool = False, num_iteration: int = -1):
+        self.booster = booster
+        self.raw_score = raw_score
+        self.leaf_index = leaf_index
+        self.num_iteration = num_iteration
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        if self.leaf_index:
+            return self.booster.predict(X, num_iteration=self.num_iteration,
+                                        pred_leaf=True)
+        return self.booster.predict(X, num_iteration=self.num_iteration,
+                                    raw_score=self.raw_score)
+
+    def predict_file(self, data_path: str, out_path: str,
+                     has_header: bool = False, label_idx: int = 0) -> None:
+        X, _, _ = parse_text_file(data_path, has_header, label_idx)
+        preds = self.predict(X)
+        with open(out_path, "w") as f:
+            if preds.ndim == 1:
+                for v in preds:
+                    f.write(f"{v:.17g}\n")
+            else:
+                for row in preds:
+                    f.write("\t".join(f"{v:.17g}" for v in row) + "\n")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    try:
+        Application(argv).run()
+    except LightGBMError as e:
+        print(f"[LightGBM-TPU] [Fatal] {e}", file=sys.stderr)
+        return 1
+    return 0

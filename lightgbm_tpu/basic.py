@@ -216,6 +216,7 @@ class Booster:
         self.best_iteration = -1
         self.best_score: Dict = {}
         self._valid_names: List[str] = []
+        self._valid_data: List["Dataset"] = []
         if train_set is not None:
             if not isinstance(train_set, Dataset):
                 raise TypeError("train_set should be Dataset instance")
@@ -225,11 +226,8 @@ class Booster:
             self._gbdt.reset_training_data(train_set._inner)
             self.train_set = train_set
         elif model_file is not None:
-            with open(model_file) as f:
-                s = f.read()
             cfg = config_from_params(params)
-            self._gbdt = create_boosting(cfg, model_file)
-            self._gbdt.load_model_from_string(s)
+            self._gbdt = create_boosting(cfg, model_file)  # loads the model
             self.train_set = None
         elif model_str is not None:
             cfg = config_from_params(params)
@@ -245,6 +243,7 @@ class Booster:
         data.construct(self.params)
         self._gbdt.add_valid(data._inner, name)
         self._valid_names.append(name)
+        self._valid_data.append(data)
         return self
 
     def update(self, train_set: Optional[Dataset] = None,
@@ -308,14 +307,25 @@ class Booster:
 
     def __eval(self, name, results, feval, is_train):
         out = [(nm, metric, val, hib) for nm, metric, val, hib in results]
-        if feval is not None:
-            if is_train and self.train_set is not None:
-                ret = feval(self.__inner_raw_score(), self.train_set)
-                if ret is not None:
-                    if isinstance(ret, tuple):
-                        ret = [ret]
-                    for fname, val, hib in ret:
-                        out.append(("training", fname, val, hib))
+        if feval is None:
+            return out
+
+        def apply(ds_name, raw, dataset):
+            ret = feval(raw, dataset)
+            if ret is None:
+                return
+            if isinstance(ret, tuple):
+                ret = [ret]
+            for fname, val, hib in ret:
+                out.append((ds_name, fname, val, hib))
+
+        if is_train and self.train_set is not None:
+            apply("training", self.__inner_raw_score(), self.train_set)
+        elif not is_train:
+            for vname, vdata, (gname, _, su, _) in zip(
+                    self._valid_names, self._valid_data,
+                    self._gbdt.valid_sets):
+                apply(vname, np.asarray(su.get()).reshape(-1), vdata)
         return out
 
     # -- prediction ---------------------------------------------------------
@@ -379,3 +389,4 @@ class Booster:
         self.best_score = state.get("best_score", {})
         self.train_set = None
         self._valid_names = []
+        self._valid_data = []

@@ -72,6 +72,12 @@ class GBDT:
         self.train_score = ScoreUpdater(
             self.learner.bins_t, self.num_data, self.K,
             train_set.metadata.init_score)
+        # continued training (input_model): replay the loaded model onto
+        # the fresh training scores (the reference re-scores via a
+        # Predictor closure during loading, application.cpp:106-113)
+        for i, t in enumerate(self.models):
+            t.rebin_to_dataset(train_set)
+            self.train_score.add_tree(t, i % self.K)
         self.feature_names = list(train_set.feature_names)
         self.feature_infos = train_set.feature_infos()
         self.max_feature_idx = train_set.num_total_features - 1
@@ -116,8 +122,10 @@ class GBDT:
             if m is not None:
                 m.init(valid_set.metadata, valid_set.num_data)
                 ms.append(m)
-        # replay existing model onto the new valid scores
+        # replay existing model onto the new valid scores (loaded trees
+        # first need in-bin thresholds for this dataset's mappers)
         for i, t in enumerate(self.models):
+            t.rebin_to_dataset(valid_set)
             su.add_tree(t, i % self.K)
         self.valid_sets.append((name, valid_set, su, ms))
 
@@ -244,12 +252,16 @@ class GBDT:
                     out.append((name, nm, v, m.factor_to_bigger_better > 0))
         return out
 
-    def eval_and_check_early_stopping(self) -> bool:
+    def eval_and_check_early_stopping(self, results=None) -> bool:
         """CLI-path early stopping (gbdt.cpp:472-578): stop when no valid
-        metric improved for early_stopping_round iterations."""
-        res = self.eval_valid()
+        metric improved for early_stopping_round iterations.  `results`
+        lets a caller that already evaluated (for logging) avoid a second
+        full metric pass."""
         esr = self.config.early_stopping_round
-        if esr <= 0 or not res:
+        if esr <= 0:
+            return False
+        res = self.eval_valid() if results is None else results
+        if not res:
             return False
         st = self._early_stopping_state
         improved = False
@@ -416,11 +428,16 @@ def create_boosting(config: Config, model_file: str = "") -> "GBDT":
     from .goss import GOSS
     table = {"gbdt": GBDT, "tree": GBDT, "dart": DART, "goss": GOSS}
     btype = config.boosting_type
+    model_str = ""
     if model_file:
         with open(model_file) as f:
-            first = f.readline().strip()
+            model_str = f.read()
+        first = model_str.split("\n", 1)[0].strip()
         if first in table:
             btype = first
     if btype not in table:
         raise ValueError(f"unknown boosting type: {btype}")
-    return table[btype](config)
+    gbdt = table[btype](config)
+    if model_str:
+        gbdt.load_model_from_string(model_str)
+    return gbdt

@@ -144,10 +144,12 @@ class Tree:
         if self._device_cache is None:
             import jax.numpy as jnp
             n = max(self.num_leaves - 1, 1)
+            binned_dec = getattr(self, "binned_decision_type",
+                                 self.decision_type)
             self._device_cache = dict(
                 split_feature_inner=jnp.asarray(self.split_feature_inner[:n]),
                 threshold_in_bin=jnp.asarray(self.threshold_in_bin[:n].astype(np.int32)),
-                decision_type=jnp.asarray(self.decision_type[:n].astype(np.int32)),
+                decision_type=jnp.asarray(binned_dec[:n].astype(np.int32)),
                 left_child=jnp.asarray(self.left_child[:n]),
                 right_child=jnp.asarray(self.right_child[:n]),
                 leaf_value=jnp.asarray(self.leaf_value[: max(self.num_leaves, 1)].astype(np.float32)),
@@ -222,7 +224,65 @@ class Tree:
             t.internal_count[: n - 1] = ints("internal_count")
         if "shrinkage" in kv:
             t.shrinkage = float(kv["shrinkage"])
+        # leaf_depth is not part of the model text — reconstruct it (the
+        # binned traversal walks `max_depth_grown` levels)
+        depth = np.zeros(n - 1, np.int32)
+        stack = [(0, 0)]
+        while stack:
+            node, d = stack.pop()
+            depth[node] = d
+            for child in (t.left_child[node], t.right_child[node]):
+                if child >= 0:
+                    stack.append((int(child), d + 1))
+                else:
+                    t.leaf_depth[~child] = d + 1
+        t.needs_rebin = True
         return t
+
+    def rebin_to_dataset(self, dataset) -> None:
+        """Reconstruct in-bin thresholds and inner feature indices for a
+        tree loaded from model text (which stores only real feature ids and
+        real-valued thresholds, tree.cpp:295+).  Needed before binned
+        score-updater replay; saved thresholds are bin upper bounds, so
+        value_to_bin recovers the original bin exactly.
+
+        Only loaded trees rebin (in-session trees already carry in-bin data
+        for the training mappers, which validation sets share); re-invoked
+        with a DIFFERENT dataset, a loaded tree rebins again from the
+        preserved real-valued thresholds.
+        """
+        if not getattr(self, "needs_rebin", False):
+            return
+        if getattr(self, "_rebin_dataset", None) is dataset:
+            return
+        # binned traversal may need a different decision op than the raw
+        # one (trivial-feature sentinels below); raw predict keeps using
+        # self.decision_type, the binned walk uses this override
+        self.binned_decision_type = self.decision_type.copy()
+        for node in range(self.num_leaves - 1):
+            real = int(self.split_feature[node])
+            inner = dataset.real_to_inner(real)
+            mapper = dataset.mappers[real]
+            if inner >= 0:
+                self.split_feature_inner[node] = inner
+                self.threshold_in_bin[node] = int(mapper.value_to_bin(
+                    np.array([self.threshold[node]]))[0])
+                self.binned_decision_type[node] = self.decision_type[node]
+            else:
+                # feature filtered as trivial in this dataset: every row
+                # has the same value, so the comparison has one outcome —
+                # encode as an always-left (huge bin) or always-right (-1)
+                # NUMERICAL test on feature 0 (bins are never negative)
+                c = mapper.bin_to_value(0)
+                if self.decision_type[node] == CATEGORICAL_DECISION:
+                    left = c == self.threshold[node]
+                else:
+                    left = c <= self.threshold[node]
+                self.split_feature_inner[node] = 0
+                self.threshold_in_bin[node] = (1 << 30) if left else -1
+                self.binned_decision_type[node] = NUMERICAL_DECISION
+        self._rebin_dataset = dataset
+        self._device_cache = None
 
     def to_json(self) -> Dict:
         def node_json(index: int) -> Dict:

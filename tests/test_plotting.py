@@ -1,0 +1,47 @@
+"""Plotting smoke tests with the Agg backend (reference
+tests/python_package_test/test_plotting.py)."""
+import matplotlib
+matplotlib.use("Agg")
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def fitted(binary_example):
+    X, y, Xt, yt = binary_example
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "verbose": -1, "min_data_in_leaf": 10}
+    train = lgb.Dataset(X, y)
+    valid = lgb.Dataset(Xt, yt, reference=train)
+    ev = {}
+    bst = lgb.train(params, train, num_boost_round=5, valid_sets=[valid],
+                    evals_result=ev, verbose_eval=False)
+    return bst, ev
+
+
+def test_plot_importance(fitted):
+    bst, _ = fitted
+    ax = lgb.plot_importance(bst, max_num_features=10)
+    assert len(ax.patches) > 0
+    assert ax.get_title() == "Feature importance"
+
+
+def test_plot_metric(fitted):
+    _, ev = fitted
+    ax = lgb.plot_metric(ev)
+    assert len(ax.lines) == 1
+    assert ax.get_ylabel() == "binary_logloss"
+
+
+def test_create_tree_digraph_requires_graphviz(fitted):
+    bst, _ = fitted
+    try:
+        import graphviz  # noqa: F401
+        g = lgb.create_tree_digraph(bst, tree_index=1)
+        assert "feature" in g.source
+    except ImportError:
+        with pytest.raises(ImportError):
+            lgb.create_tree_digraph(bst, tree_index=1)

@@ -1,0 +1,78 @@
+"""sklearn-wrapper tests (reference tests/python_package_test/
+test_sklearn.py:17-136): regressor/classifier/ranker, custom objective,
+pickle round-trip, clone."""
+import pickle
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu import LGBMClassifier, LGBMRegressor, LGBMRanker
+
+
+def test_regressor(regression_example):
+    X, y, Xt, yt = regression_example
+    reg = LGBMRegressor(n_estimators=20, min_child_samples=10)
+    reg.fit(X, y, eval_set=[(Xt, yt)], verbose=False)
+    mse = np.mean((reg.predict(Xt) - yt) ** 2)
+    assert mse < 1.0
+
+
+def test_classifier(binary_example):
+    X, y, Xt, yt = binary_example
+    clf = LGBMClassifier(n_estimators=20, min_child_samples=10)
+    clf.fit(X, y, verbose=False)
+    proba = clf.predict_proba(Xt)
+    assert proba.shape == (len(yt), 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-6)
+    acc = np.mean(clf.predict(Xt) == yt)
+    assert acc > 0.7
+    assert set(clf.classes_) == {0.0, 1.0}
+
+
+def test_classifier_multiclass(multiclass_example):
+    X, y, Xt, yt = multiclass_example
+    clf = LGBMClassifier(n_estimators=15, min_child_samples=10)
+    clf.fit(X, y, verbose=False)
+    proba = clf.predict_proba(Xt)
+    assert proba.shape == (len(yt), 5)
+    assert np.mean(clf.predict(Xt) == yt) > 0.3
+
+
+def test_ranker(rank_example):
+    X, y, q, Xt, yt, qt = rank_example
+    rk = LGBMRanker(n_estimators=20, min_child_samples=20)
+    rk.fit(X, y, group=q, verbose=False)
+    s = rk.predict(Xt)
+    assert s.shape == (len(yt),)
+
+
+def test_pickle_roundtrip(binary_example):
+    X, y, Xt, yt = binary_example
+    clf = LGBMClassifier(n_estimators=8, min_child_samples=10)
+    clf.fit(X, y, verbose=False)
+    blob = pickle.dumps(clf)
+    clf2 = pickle.loads(blob)
+    np.testing.assert_allclose(clf.predict_proba(Xt),
+                               clf2.predict_proba(Xt), rtol=1e-12)
+
+
+def test_custom_objective(regression_example):
+    X, y, Xt, yt = regression_example
+
+    def l2_obj(labels, preds):
+        return (preds - labels).astype(np.float32), \
+            np.ones_like(preds, np.float32)
+
+    reg = LGBMRegressor(n_estimators=15, objective=l2_obj,
+                        min_child_samples=10)
+    reg.fit(X, y, verbose=False)
+    assert np.mean((reg.predict(Xt) - yt) ** 2) < 1.5
+
+
+def test_feature_importances(binary_example):
+    X, y, _, _ = binary_example
+    clf = LGBMClassifier(n_estimators=8, min_child_samples=10)
+    clf.fit(X, y, verbose=False)
+    imp = clf.feature_importances_
+    assert imp.shape == (X.shape[1],)
+    assert imp.sum() > 0
