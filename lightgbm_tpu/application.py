@@ -69,6 +69,10 @@ class Application:
             raise LightGBMError("no training data: set data=<file>")
         t0 = time.time()
         train_raw = RawDataset.from_file(cfg.data, cfg)
+        if cfg.is_save_binary_file and not RawDataset._is_binary_file(
+                cfg.data):
+            train_raw.save_binary(cfg.data + ".bin")
+            _log(cfg, f"saved binary dataset cache to {cfg.data}.bin")
         _log(cfg, f"finished loading data in {time.time() - t0:.6f} seconds")
         _log(cfg, f"number of data: {train_raw.num_data}, number of "
                   f"features: {train_raw.num_features}")

@@ -175,6 +175,13 @@ class Dataset:
         self.construct()
         return self._inner.metadata.init_score
 
+    def save_binary(self, filename: str) -> "Dataset":
+        """Serialize the constructed binned dataset (reference
+        basic.py save_binary → LGBM_DatasetSaveBinary)."""
+        self.construct()
+        self._inner.save_binary(filename)
+        return self
+
     def num_data(self) -> int:
         self.construct()
         return self._inner.num_data

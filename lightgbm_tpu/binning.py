@@ -44,8 +44,11 @@ class BinMapper:
     sparse_rate: float = 0.0
 
     def value_to_bin(self, values: np.ndarray) -> np.ndarray:
-        """Vectorized value->bin (reference bin.h:418-440)."""
+        """Vectorized value->bin (reference bin.h:418-440).  NaN maps to
+        value 0 (v2.0-era missing handling; searchsorted would otherwise
+        return an out-of-range bin)."""
         values = np.asarray(values, dtype=np.float64)
+        values = np.where(np.isnan(values), 0.0, values)
         if self.bin_type == NUMERICAL:
             return np.searchsorted(self.bin_upper_bound, values, side="left").astype(
                 np.int32)

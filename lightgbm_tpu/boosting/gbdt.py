@@ -175,29 +175,35 @@ class GBDT:
                        is_eval: bool = False) -> bool:
         """One boosting iteration.  Returns True when training should stop
         (early stopping or no splittable leaves)."""
+        from .. import profiling
         self._boost_from_average()
         if gradient is None or hessian is None:
-            gradient, hessian = self.boosting_gradients()
-        self._bagging(self.iter_)
+            with profiling.phase("boosting"):
+                gradient, hessian = self.boosting_gradients()
+        with profiling.phase("bagging"):
+            self._bagging(self.iter_)
 
         should_continue = False
         bag = self.bag_idx if (self.need_bagging and self.bag_cnt < self.num_data) else None
         for k in range(self.K):
             if self.class_need_train[k]:
-                tree, leaf_id = self.learner.train(
-                    gradient[k], hessian[k], bag, self.bag_cnt if bag is not None else None)
+                with profiling.phase("tree"):
+                    tree, leaf_id = self.learner.train(
+                        gradient[k], hessian[k], bag,
+                        self.bag_cnt if bag is not None else None)
             else:
                 tree = Tree(2)
                 leaf_id = None
             if tree.num_leaves > 1:
                 should_continue = True
                 tree.apply_shrinkage(self.shrinkage_rate)
-                if leaf_id is not None and (
-                        bag is None
-                        or getattr(self.learner, "full_leaf_id", False)):
-                    self.train_score.add_tree_by_leaf_id(tree, leaf_id, k)
-                else:
-                    self.train_score.add_tree(tree, k)
+                with profiling.phase("score"):
+                    if leaf_id is not None and (
+                            bag is None
+                            or getattr(self.learner, "full_leaf_id", False)):
+                        self.train_score.add_tree_by_leaf_id(tree, leaf_id, k)
+                    else:
+                        self.train_score.add_tree(tree, k)
                 for _, _, su, _ in self.valid_sets:
                     su.add_tree(tree, k)
             else:
@@ -244,12 +250,15 @@ class GBDT:
         return out
 
     def eval_valid(self) -> List[Tuple[str, str, float, bool]]:
+        from .. import profiling
         out = []
-        for name, _, su, ms in self.valid_sets:
-            score = su.get()
-            for m in ms:
-                for nm, v in m.eval(score, self.objective):
-                    out.append((name, nm, v, m.factor_to_bigger_better > 0))
+        with profiling.phase("metric"):
+            for name, _, su, ms in self.valid_sets:
+                score = su.get()
+                for m in ms:
+                    for nm, v in m.eval(score, self.objective):
+                        out.append((name, nm, v,
+                                    m.factor_to_bigger_better > 0))
         return out
 
     def eval_and_check_early_stopping(self, results=None) -> bool:

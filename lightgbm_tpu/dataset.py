@@ -52,7 +52,16 @@ def parse_text_file(path: str, has_header: bool = False, label_idx: int = 0
     Auto-detects the format from the first data line like the reference
     Parser::CreateParser.  The label is column `label_idx` for csv/tsv and
     the first token for libsvm.
+
+    The native C++ parser (src/native/loader.cpp) is used when built;
+    header names are only needed for has_header files, which keep the
+    Python path.
     """
+    if not has_header:
+        from .native import parse_text_native
+        res = parse_text_native(path, has_header, label_idx)
+        if res is not None:
+            return res[0], res[1], None
     with open(path, "r") as f:
         first = f.readline()
         if not first:
@@ -215,8 +224,24 @@ class Dataset:
         self.max_num_bin = int(self.num_bins.max()) if F else 1
         dtype = np.uint8 if self.max_num_bin <= 256 else np.uint16
         self.bins = np.empty((F, n), dtype=dtype)
+        # numerical columns go through the native bulk binner when built
+        # (src/native/loader.cpp lgbt_bin_numerical); the rest via NumPy
+        num_ks = [k for k, i in enumerate(self.used_features)
+                  if self.mappers[i].bin_type == NUMERICAL]
+        done = set()
+        if dtype == np.uint8 and num_ks:
+            from .native import bin_numerical_native
+            cols = [self.used_features[k] for k in num_ks]
+            uppers = [self.mappers[i].bin_upper_bound for i in cols]
+            out = bin_numerical_native(X, cols, uppers)
+            if out is not None:
+                for j, k in enumerate(num_ks):
+                    self.bins[k] = out[j]
+                done = set(num_ks)
         for k, i in enumerate(self.used_features):
-            self.bins[k] = self.mappers[i].value_to_bin(X[:, i]).astype(dtype)
+            if k not in done:
+                self.bins[k] = self.mappers[i].value_to_bin(
+                    X[:, i]).astype(dtype)
         self.is_categorical = np.array(
             [self.mappers[i].bin_type == CATEGORICAL for i in self.used_features],
             dtype=bool)
@@ -241,7 +266,12 @@ class Dataset:
         return self.used_features[inner]
 
     def real_to_inner(self, real: int) -> int:
-        return self.used_features.index(real)
+        """Inner (used-feature) index, or -1 when the raw feature was
+        filtered as trivial."""
+        try:
+            return self.used_features.index(real)
+        except ValueError:
+            return -1
 
     def device_bins(self):
         """[F, N+1] device array with a sentinel row slot at index N
@@ -258,10 +288,136 @@ class Dataset:
     def feature_infos(self) -> List[str]:
         return [m.feature_info() for m in self.mappers]
 
+    # -- binary cache (reference dataset.cpp:18,323-407 SaveBinaryFile /
+    #    LoadFromBinFile with magic token) --------------------------------
+    # Stored as a magic line + npz (allow_pickle=False on load: a data
+    # file is untrusted input and must never reach pickle).
+
+    BINARY_MAGIC = "lightgbm_tpu.dataset.v2"
+
+    def save_binary(self, path: str) -> None:
+        """Serialize the binned dataset so reloads skip parse+bin."""
+        import io
+        md = self.metadata
+        arrays = {
+            "bins": self.bins,
+            "num_data": np.int64(self.num_data),
+            "num_total_features": np.int64(self.num_total_features),
+            "used_features": np.asarray(self.used_features, np.int64),
+            "feature_names": np.asarray(self.feature_names, dtype="U"),
+            "label": md.label,
+            "max_bin": np.int64(self.config.max_bin),
+        }
+        for opt, name in ((md.weights, "weights"),
+                          (md.query_boundaries, "query_boundaries"),
+                          (md.init_score, "init_score")):
+            if opt is not None:
+                arrays[name] = opt
+        for i, m in enumerate(self.mappers):
+            arrays[f"m{i}_meta"] = np.asarray(
+                [m.bin_type, m.num_bin, 1 if m.is_trivial else 0,
+                 m.default_bin], np.int64)
+            arrays[f"m{i}_fl"] = np.asarray(
+                [m.min_val, m.max_val, m.sparse_rate], np.float64)
+            arrays[f"m{i}_upper"] = np.asarray(m.bin_upper_bound, np.float64)
+            arrays[f"m{i}_cats"] = np.asarray(m.bin_2_categorical, np.int64)
+        buf = io.BytesIO()
+        np.savez_compressed(buf, **arrays)
+        with open(path, "wb") as f:
+            f.write(self.BINARY_MAGIC.encode() + b"\n")
+            f.write(buf.getvalue())
+
+    @classmethod
+    def from_binary(cls, path: str, config: Optional[Config] = None
+                    ) -> "Dataset":
+        cfg = config or Config()
+        with open(path, "rb") as f:
+            first = f.readline()
+            if first.strip().decode(errors="replace") != cls.BINARY_MAGIC:
+                raise ValueError(
+                    f"{path} is not a lightgbm_tpu binary dataset")
+            npz = np.load(f, allow_pickle=False)
+            d = {k: npz[k] for k in npz.files}  # materialize before close
+        if int(d["max_bin"]) != cfg.max_bin:
+            raise ValueError(
+                f"binary dataset {path} was built with max_bin="
+                f"{int(d['max_bin'])}, config wants {cfg.max_bin}; "
+                "delete the cache to rebuild")
+        ds = cls.__new__(cls)
+        ds.config = cfg
+        ds.bins = d["bins"]
+        ds.num_data = int(d["num_data"])
+        ds.num_total_features = int(d["num_total_features"])
+        ds.used_features = [int(i) for i in d["used_features"]]
+        ds.feature_names = [str(s) for s in d["feature_names"]]
+        ds.mappers = []
+        for i in range(ds.num_total_features):
+            meta = d[f"m{i}_meta"]
+            fl = d[f"m{i}_fl"]
+            cats = [int(c) for c in d[f"m{i}_cats"]]
+            ds.mappers.append(BinMapper(
+                bin_type=int(meta[0]), num_bin=int(meta[1]),
+                is_trivial=bool(meta[2]), default_bin=int(meta[3]),
+                min_val=float(fl[0]), max_val=float(fl[1]),
+                sparse_rate=float(fl[2]),
+                bin_upper_bound=d[f"m{i}_upper"],
+                bin_2_categorical=cats,
+                categorical_2_bin={c: j for j, c in enumerate(cats)}))
+        ds.num_bins = np.array([ds.mappers[i].num_bin
+                                for i in ds.used_features], np.int32)
+        ds.max_num_bin = int(ds.num_bins.max()) if ds.used_features else 1
+        ds.is_categorical = np.array(
+            [ds.mappers[i].bin_type == CATEGORICAL
+             for i in ds.used_features], bool)
+        ds.metadata = Metadata(
+            label=d["label"],
+            weights=d["weights"] if "weights" in d else None,
+            query_boundaries=(d["query_boundaries"]
+                              if "query_boundaries" in d else None),
+            init_score=d["init_score"] if "init_score" in d else None)
+        ds._device_bins = None
+        return ds
+
+    @staticmethod
+    def _is_binary_file(path: str) -> bool:
+        try:
+            with open(path, "rb") as f:
+                head = f.read(len(Dataset.BINARY_MAGIC) + 1)
+            return head.startswith(Dataset.BINARY_MAGIC.encode())
+        except OSError:
+            return False
+
     @staticmethod
     def from_file(path: str, config: Optional[Config] = None,
                   reference: Optional["Dataset"] = None) -> "Dataset":
         cfg = config or Config()
+        # binary cache: <data>.bin next to the file, or the file itself
+        # (reference dataset_loader.cpp:263+ token detection)
+        if cfg.enable_load_from_binary_file:
+            bin_path = None
+            if Dataset._is_binary_file(path):
+                bin_path = path
+            elif os.path.exists(path + ".bin") and \
+                    Dataset._is_binary_file(path + ".bin") and \
+                    os.path.getmtime(path + ".bin") >= os.path.getmtime(path):
+                bin_path = path + ".bin"
+            if bin_path is not None:
+                if cfg.verbose >= 1:
+                    print(f"[LightGBM-TPU] [Info] loading binary dataset "
+                          f"cache {bin_path}", flush=True)
+                ds = Dataset.from_binary(bin_path, cfg)
+                if reference is not None:
+                    # valid-set alignment (reference Dataset::CheckAlign,
+                    # dataset.h:298-314): bin mappers must match the
+                    # training set's
+                    if (ds.num_total_features
+                            != reference.num_total_features or
+                            any(a.num_bin != b.num_bin for a, b in
+                                zip(ds.mappers, reference.mappers))):
+                        raise ValueError(
+                            f"binary validation data {bin_path} was binned "
+                            "differently from the training data")
+                return ds
         label_idx = 0
         if cfg.label_column.startswith("name:"):
             raise NotImplementedError("label by name requires header support")

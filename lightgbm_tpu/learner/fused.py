@@ -73,7 +73,9 @@ def build_tree(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
                data_axis: Optional[str] = None,
                feature_axis: Optional[str] = None,
                feature_shard_size: int = 0,
-               input_dtype: str = "float32"):
+               input_dtype: str = "float32",
+               voting_k: int = 0,
+               num_machines: int = 1):
     """Grow one tree; runs per-shard inside `shard_map` (or standalone when
     both axes are None).
 
@@ -90,14 +92,29 @@ def build_tree(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
     f_off = (jax.lax.axis_index(feature_axis) * feature_shard_size
              if feature_axis is not None else jnp.int32(0))
 
+    voting = voting_k > 0 and data_axis is not None
+
     def make_hist(mask):
         h = histogram_full_masked(bins, grad, hess, mask,
                                   num_bins_padded=B, input_dtype=input_dtype)
-        return _psum(h, data_axis)
+        # voting keeps histograms LOCAL: only the voted feature subset is
+        # reduced, inside find_best (PV-Tree,
+        # voting_parallel_tree_learner.cpp:314-350)
+        return h if voting else _psum(h, data_axis)
+
+    def can_gate(p, sums):
+        # can-this-child-be-split-again gate (serial_tree_learner.cpp
+        # _can_split checks; depth gate applied by caller via leaf_best)
+        can = ((sums[2] >= 2 * min_data_in_leaf)
+               & (sums[1] >= 2 * min_sum_hessian_in_leaf))
+        gain = jnp.where(can & jnp.isfinite(p[0]) & (p[0] > 0), p[0], NEG_INF)
+        return p.at[0].set(gain)
 
     def find_best(hist, sums):
         """Global best split record given this shard's histogram block and
         the leaf's GLOBAL (sum_grad, sum_hess, count)."""
+        if voting:
+            return find_best_voting(hist, sums)
         rec = best_split(hist, num_bins, is_cat, fmask,
                          sums[0], sums[1], sums[2], **skw)
         p = rec.packed()
@@ -107,12 +124,38 @@ def build_tree(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
             # argmax picks the first max → smallest shard → smallest
             # feature id among ties (split_info.hpp:100-105 determinism)
             p = allp[jnp.argmax(allp[:, 0])]
-        # can-this-child-be-split-again gate (serial_tree_learner.cpp
-        # _can_split checks; depth gate applied by caller via leaf_best)
-        can = ((sums[2] >= 2 * min_data_in_leaf)
-               & (sums[1] >= 2 * min_sum_hessian_in_leaf))
-        gain = jnp.where(can & jnp.isfinite(p[0]) & (p[0] > 0), p[0], NEG_INF)
-        return p.at[0].set(gain)
+        return can_gate(p, sums)
+
+    def find_best_voting(hist_local, sums):
+        """PV-Tree split search (voting_parallel_tree_learner.cpp:163-251):
+        local per-feature bests with relaxed constraints → local top-k →
+        vote all_gather → global top-2k feature subset → psum only those
+        features' histograms → exact best split on the subset."""
+        from ..ops.split import split_gain_matrix
+        local_sums = jnp.stack([jnp.sum(hist_local[0, 0, :]),
+                                jnp.sum(hist_local[0, 1, :]),
+                                jnp.sum(hist_local[0, 2, :])])
+        relaxed = dict(skw)
+        relaxed["min_data_in_leaf"] = max(
+            1, skw["min_data_in_leaf"] // max(num_machines, 1))
+        relaxed["min_sum_hessian_in_leaf"] = (
+            skw["min_sum_hessian_in_leaf"] / max(num_machines, 1))
+        gains, _, _, _ = split_gain_matrix(
+            hist_local, num_bins, is_cat, fmask,
+            local_sums[0], local_sums[1], local_sums[2], **relaxed)
+        per_feat = jnp.max(gains, axis=1)                  # [F]
+        k = min(voting_k, per_feat.shape[0])
+        _, topk = jax.lax.top_k(per_feat, k)               # [k] local vote
+        allv = jax.lax.all_gather(topk, data_axis).reshape(-1)
+        votes = jnp.zeros(per_feat.shape[0], jnp.int32).at[allv].add(1)
+        k2 = min(2 * k, per_feat.shape[0])
+        _, sel = jax.lax.top_k(votes, k2)                  # [2k] selected
+        hist_sel = _psum(hist_local[sel], data_axis)       # [2k, 3, B]
+        rec = best_split(hist_sel, num_bins[sel], is_cat[sel], fmask[sel],
+                         sums[0], sums[1], sums[2], **skw)
+        p = rec.packed()
+        p = p.at[1].set(sel[rec.feature].astype(jnp.float32))
+        return can_gate(p, sums)
 
     def go_left_row(feat, thr, catf):
         """[Nloc] bool: does each local row go left under (feat, thr)?
@@ -137,6 +180,10 @@ def build_tree(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
     sum_h = jnp.sum(hist0[0, 1, :])
     cnt = jnp.sum(hist0[0, 2, :])
     root_sums = jnp.stack([sum_g, sum_h, cnt])
+    if voting:
+        # hist0 is local in voting mode; root totals are global
+        root_sums = _psum(root_sums, data_axis)
+        sum_g, sum_h, cnt = root_sums[0], root_sums[1], root_sums[2]
     if feature_axis is not None:
         # shard 0 always holds real features (padding only at the tail)
         root_sums = jax.lax.all_gather(root_sums, feature_axis)[0]
@@ -367,10 +414,15 @@ class FusedTreeLearner:
         self.split_kw = make_split_kw(cfg)
         self._feat_rng = np.random.RandomState(cfg.feature_fraction_seed)
 
+        voting = (getattr(cfg, "tree_learner", "") == "voting"
+                  and self.dd > 1)
         kw = dict(num_leaves=cfg.num_leaves, num_bins_padded=self.B,
                   split_kw=self.split_kw, max_depth=int(cfg.max_depth),
                   min_data_in_leaf=int(cfg.min_data_in_leaf),
-                  min_sum_hessian_in_leaf=float(cfg.min_sum_hessian_in_leaf))
+                  min_sum_hessian_in_leaf=float(cfg.min_sum_hessian_in_leaf),
+                  voting_k=int(cfg.top_k) if voting else 0,
+                  num_machines=self.dd,
+                  input_dtype=getattr(cfg, "histogram_dtype", "float32"))
         if mesh is None:
             fn = functools.partial(build_tree, **kw)
             self._build = jax.jit(fn)
@@ -490,6 +542,9 @@ def create_tree_learner(dataset: Dataset, config: Config):
 
     feature_sharded = (mesh is not None and dict(
         zip(mesh.axis_names, mesh.devices.shape)).get("feature", 1) > 1)
+    if lt == "voting" and mesh is not None:
+        # PV-Tree needs the per-split vote exchange of the fused builder
+        return FusedTreeLearner(dataset, config, mesh)
     if growth == "rounds" and not feature_sharded:
         from .rounds import RoundsTreeLearner
         return RoundsTreeLearner(dataset, config, mesh)

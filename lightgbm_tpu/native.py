@@ -1,0 +1,107 @@
+"""ctypes binding to the native loader (src/native/loader.cpp).
+
+The reference reaches its native IO through a ctypes-loaded shared library
+(python-package/lightgbm/basic.py:21-32, libpath.py); this module plays
+that role for the TPU build.  Everything degrades to the NumPy
+implementations when the library hasn't been built
+(scripts/build_native.sh).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _find_lib() -> Optional[str]:
+    here = os.path.dirname(os.path.abspath(__file__))
+    cand = os.path.join(here, "lib", "liblgbt_native.so")
+    return cand if os.path.exists(cand) else None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    path = _find_lib()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        lib.lgbt_parse_text.restype = ctypes.c_void_p
+        lib.lgbt_parse_text.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
+            ctypes.c_int64]
+        lib.lgbt_matrix_rows.restype = ctypes.c_int64
+        lib.lgbt_matrix_rows.argtypes = [ctypes.c_void_p]
+        lib.lgbt_matrix_cols.restype = ctypes.c_int64
+        lib.lgbt_matrix_cols.argtypes = [ctypes.c_void_p]
+        lib.lgbt_matrix_copy.restype = None
+        lib.lgbt_matrix_copy.argtypes = [
+            ctypes.c_void_p,
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")]
+        lib.lgbt_free_matrix.restype = None
+        lib.lgbt_free_matrix.argtypes = [ctypes.c_void_p]
+        lib.lgbt_bin_numerical.restype = None
+        lib.lgbt_bin_numerical.argtypes = [
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+            ctypes.c_int64, ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")]
+        _LIB = lib
+    except OSError:
+        _LIB = None
+    return _LIB
+
+
+def parse_text_native(path: str, has_header: bool, label_idx: int
+                      ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """(X, y) via the native parser, or None when unavailable/failed."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    err = ctypes.create_string_buffer(512)
+    h = lib.lgbt_parse_text(path.encode(), int(has_header), int(label_idx),
+                            err, 512)
+    if not h:
+        raise ValueError(err.value.decode() or f"failed to parse {path}")
+    try:
+        n = lib.lgbt_matrix_rows(h)
+        f = lib.lgbt_matrix_cols(h)
+        X = np.empty((n, f), np.float64)
+        y = np.empty(n, np.float64)
+        lib.lgbt_matrix_copy(h, X, y)
+        return X, y
+    finally:
+        lib.lgbt_free_matrix(h)
+
+
+def bin_numerical_native(X: np.ndarray, cols: List[int],
+                         uppers_list: List[np.ndarray]
+                         ) -> Optional[np.ndarray]:
+    """Column-major [len(cols), n] uint8 bins, or None when unavailable.
+    Only valid when every feature has ≤ 256 bins."""
+    lib = get_lib()
+    if lib is None or any(len(u) > 256 for u in uppers_list):
+        return None
+    X = np.ascontiguousarray(X, np.float64)
+    n, stride = X.shape
+    cols_a = np.asarray(cols, np.int32)
+    offsets = np.zeros(len(uppers_list) + 1, np.int64)
+    offsets[1:] = np.cumsum([len(u) for u in uppers_list])
+    uppers = (np.concatenate(uppers_list).astype(np.float64)
+              if len(uppers_list) else np.zeros(0, np.float64))
+    out = np.empty((len(cols), n), np.uint8)
+    lib.lgbt_bin_numerical(X, n, stride, cols_a, len(cols), uppers, offsets,
+                           out)
+    return out

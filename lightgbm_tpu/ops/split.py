@@ -67,25 +67,17 @@ def leaf_output(G, H, l1, l2):
     return -jnp.sign(G) * reg / (H + l2)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("lambda_l1", "lambda_l2", "min_data_in_leaf",
-                     "min_sum_hessian_in_leaf", "min_gain_to_split"))
-def best_split(hist: jax.Array, num_bins: jax.Array, is_cat: jax.Array,
-               feature_mask: jax.Array, sum_grad: jax.Array,
-               sum_hess: jax.Array, num_data: jax.Array, *,
-               lambda_l1: float = 0.0, lambda_l2: float = 0.0,
-               min_data_in_leaf: int = 20,
-               min_sum_hessian_in_leaf: float = 1e-3,
-               min_gain_to_split: float = 0.0) -> SplitResult:
-    """Find the best split of one leaf from its histogram.
-
-    hist : [F, 3, B] f32 (sum_grad, sum_hess, count)
-    num_bins : [F] int32 actual bins per feature
-    is_cat : [F] bool
-    feature_mask : [F] bool (feature_fraction subset for this tree)
-    sum_grad/sum_hess/num_data : leaf totals (host-accurate scalars)
-    """
+def split_gain_matrix(hist: jax.Array, num_bins: jax.Array, is_cat: jax.Array,
+                      feature_mask: jax.Array, sum_grad: jax.Array,
+                      sum_hess: jax.Array, num_data: jax.Array, *,
+                      lambda_l1: float = 0.0, lambda_l2: float = 0.0,
+                      min_data_in_leaf: int = 20,
+                      min_sum_hessian_in_leaf: float = 1e-3,
+                      min_gain_to_split: float = 0.0):
+    """[F, B] total gain per candidate threshold (K_MIN_SCORE where
+    invalid), plus (GL, HL, CL) cumulatives for record assembly.  Exposed
+    separately from `best_split` so the voting-parallel learner can rank
+    features locally (voting_parallel_tree_learner.cpp local top-k)."""
     F, _, B = hist.shape
     l1, l2 = lambda_l1, lambda_l2
     g, h, c = hist[:, 0, :], hist[:, 1, :], hist[:, 2, :]
@@ -117,6 +109,37 @@ def best_split(hist: jax.Array, num_bins: jax.Array, is_cat: jax.Array,
     total_gain = leaf_split_gain(GL, HL, l1, l2) + leaf_split_gain(GR, HR, l1, l2)
     total_gain = jnp.where(valid & (total_gain > min_gain_shift),
                            total_gain, K_MIN_SCORE)
+    return total_gain, GL, HL, CL
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("lambda_l1", "lambda_l2", "min_data_in_leaf",
+                     "min_sum_hessian_in_leaf", "min_gain_to_split"))
+def best_split(hist: jax.Array, num_bins: jax.Array, is_cat: jax.Array,
+               feature_mask: jax.Array, sum_grad: jax.Array,
+               sum_hess: jax.Array, num_data: jax.Array, *,
+               lambda_l1: float = 0.0, lambda_l2: float = 0.0,
+               min_data_in_leaf: int = 20,
+               min_sum_hessian_in_leaf: float = 1e-3,
+               min_gain_to_split: float = 0.0) -> SplitResult:
+    """Find the best split of one leaf from its histogram.
+
+    hist : [F, 3, B] f32 (sum_grad, sum_hess, count)
+    num_bins : [F] int32 actual bins per feature
+    is_cat : [F] bool
+    feature_mask : [F] bool (feature_fraction subset for this tree)
+    sum_grad/sum_hess/num_data : leaf totals (host-accurate scalars)
+    """
+    F, _, B = hist.shape
+    l1, l2 = lambda_l1, lambda_l2
+    total_gain, GL, HL, CL = split_gain_matrix(
+        hist, num_bins, is_cat, feature_mask, sum_grad, sum_hess, num_data,
+        lambda_l1=lambda_l1, lambda_l2=lambda_l2,
+        min_data_in_leaf=min_data_in_leaf,
+        min_sum_hessian_in_leaf=min_sum_hessian_in_leaf,
+        min_gain_to_split=min_gain_to_split)
+    gain_shift = leaf_split_gain(sum_grad, sum_hess, l1, l2)
 
     flat = total_gain.reshape(-1)
     best = jnp.argmax(flat)

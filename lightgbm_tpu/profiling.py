@@ -1,0 +1,75 @@
+"""Phase-bucketed wall-clock tracing (reference TIMETAG subsystem:
+std::chrono accumulators over boosting/bagging/tree/score/metric phases,
+gbdt.cpp:20-29,50-60, serial_tree_learner.cpp:10-17, logged at teardown)
+plus a hook into jax.profiler for device traces.
+
+Enable with LIGHTGBM_TPU_TIMETAG=1 (compile-time macro in the reference →
+environment switch here); totals print at interpreter exit or via
+`report()`.
+"""
+from __future__ import annotations
+
+import atexit
+import os
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Dict, Iterator, Optional
+
+ENABLED = os.environ.get("LIGHTGBM_TPU_TIMETAG", "0") not in ("0", "", "false")
+
+_totals: Dict[str, float] = defaultdict(float)
+_counts: Dict[str, int] = defaultdict(int)
+
+
+@contextmanager
+def phase(name: str) -> Iterator[None]:
+    """Accumulate wall-clock under `name`.  No-op unless enabled."""
+    if not ENABLED:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        _totals[name] += time.perf_counter() - t0
+        _counts[name] += 1
+
+
+def add(name: str, seconds: float) -> None:
+    if ENABLED:
+        _totals[name] += seconds
+        _counts[name] += 1
+
+
+def report() -> Dict[str, float]:
+    """Totals per phase; also printed when TIMETAG is on (reference logs
+    at destructor time)."""
+    if ENABLED and _totals:
+        print("[LightGBM-TPU] [Info] ===== timer totals =====", flush=True)
+        for name in sorted(_totals, key=_totals.get, reverse=True):
+            print(f"[LightGBM-TPU] [Info] {name}: {_totals[name]:.4f}s "
+                  f"({_counts[name]} calls)", flush=True)
+    return dict(_totals)
+
+
+def reset() -> None:
+    _totals.clear()
+    _counts.clear()
+
+
+if ENABLED:
+    atexit.register(report)
+
+
+@contextmanager
+def device_trace(logdir: str) -> Iterator[None]:
+    """jax.profiler trace wrapper — the TPU analog of the reference's GPU
+    transfer/kernel timing logs (gpu_tree_learner.cpp:538-542).  View with
+    TensorBoard or xprof."""
+    import jax
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,10 @@
+#!/bin/sh
+# Build the native loader shared library (src/native/loader.cpp).
+# Output: lightgbm_tpu/lib/liblgbt_native.so — picked up automatically by
+# lightgbm_tpu/native.py; everything falls back to NumPy when absent.
+set -e
+cd "$(dirname "$0")/.."
+mkdir -p lightgbm_tpu/lib
+g++ -O3 -march=native -std=c++17 -shared -fPIC \
+    -o lightgbm_tpu/lib/liblgbt_native.so src/native/loader.cpp
+echo "built lightgbm_tpu/lib/liblgbt_native.so"

@@ -1,0 +1,76 @@
+"""Aux subsystem tests: PMML export, profiling timers, native loader."""
+import os
+import xml.etree.ElementTree as ET
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def model(binary_example):
+    X, y, _, _ = binary_example
+    return lgb.train({"objective": "binary", "verbose": -1,
+                      "min_data_in_leaf": 10}, lgb.Dataset(X, y),
+                     num_boost_round=3, verbose_eval=False)
+
+
+def test_pmml_export(model, tmp_path):
+    from lightgbm_tpu.pmml import save_pmml, model_to_pmml
+    p = tmp_path / "model.pmml"
+    save_pmml(model, str(p))
+    root = ET.parse(p).getroot()  # well-formed XML
+    ns = "{http://www.dmg.org/PMML-4_2}"
+    segs = root.findall(f".//{ns}Segment")
+    assert len(segs) == model.num_trees()
+    assert root.findall(f".//{ns}TreeModel")
+    s = model_to_pmml(model)
+    assert "SimplePredicate" in s
+
+
+def test_profiling_timers(binary_example, monkeypatch):
+    from lightgbm_tpu import profiling
+    monkeypatch.setattr(profiling, "ENABLED", True)
+    profiling.reset()
+    X, y, _, _ = binary_example
+    lgb.train({"objective": "binary", "verbose": -1,
+               "min_data_in_leaf": 10}, lgb.Dataset(X, y),
+              num_boost_round=2, verbose_eval=False)
+    totals = profiling.report()
+    assert totals.get("tree", 0) > 0
+    assert totals.get("boosting", 0) > 0
+    profiling.reset()
+
+
+def test_native_loader_matches_numpy():
+    from lightgbm_tpu import native
+    import lightgbm_tpu.dataset as dsm
+    path = "/root/reference/examples/lambdarank/rank.train"  # libsvm
+    res = native.parse_text_native(path, False, 0)
+    if res is None:
+        pytest.skip("native library not built")
+    Xn, yn = res
+    lib = native._LIB
+    native._LIB = None
+    try:
+        Xp, yp, _ = dsm.parse_text_file(path)
+    finally:
+        native._LIB = lib
+    np.testing.assert_allclose(Xn, Xp)
+    np.testing.assert_allclose(yn, yp)
+
+
+def test_native_bin_numerical_matches_searchsorted():
+    from lightgbm_tpu.native import bin_numerical_native
+    rng = np.random.RandomState(0)
+    X = rng.randn(500, 4)
+    uppers = [np.sort(rng.randn(17)) for _ in range(3)]
+    for u in uppers:
+        u[-1] = np.inf
+    out = bin_numerical_native(X, [0, 2, 3], uppers)
+    if out is None:
+        pytest.skip("native library not built")
+    for j, (col, u) in enumerate(zip([0, 2, 3], uppers)):
+        expect = np.searchsorted(u, X[:, col], side="left")
+        np.testing.assert_array_equal(out[j], expect)

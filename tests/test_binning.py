@@ -114,6 +114,25 @@ def test_leaf_output_math():
     assert float(leaf_split_gain(4.0, 3.0, 1.0, 1.0)) == pytest.approx(9 / 4)
 
 
+def test_binary_dataset_cache_roundtrip(tmp_path, binary_example):
+    """save_binary → from_file auto-detects the cache and trains
+    identically (reference dataset.cpp binary cache + magic token)."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.dataset import Dataset as RawDataset
+    from lightgbm_tpu.config import config_from_params
+    X, y, _, _ = binary_example
+    cfg = config_from_params({"objective": "binary", "verbose": -1})
+    ds = RawDataset(X, y, config=cfg)
+    p = str(tmp_path / "train.bin")
+    ds.save_binary(p)
+    assert RawDataset._is_binary_file(p)
+    ds2 = RawDataset.from_file(p, cfg)
+    np.testing.assert_array_equal(ds.bins, ds2.bins)
+    np.testing.assert_array_equal(np.asarray(ds.metadata.label),
+                                  np.asarray(ds2.metadata.label))
+    assert ds2.used_features == ds.used_features
+
+
 def test_valid_set_uses_train_binning(binary_example):
     from lightgbm_tpu.dataset import Dataset as RawDataset
     from lightgbm_tpu.config import config_from_params

@@ -103,6 +103,42 @@ def test_sharded_bagging_counts(small_problem):
     assert root_count == n_bag, f"padding row leaked into bag: {root_count}"
 
 
+def test_voting_parallel_matches_data_parallel_when_topk_covers():
+    """PV-Tree voting (voting_parallel_tree_learner.cpp semantics): with
+    top_k >= num_features every feature's histogram is exchanged, so the
+    tree must equal plain data-parallel exactly."""
+    rng = np.random.RandomState(7)
+    N, F = 3000, 50
+    X = rng.randn(N, F)
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] - 0.3 * X[:, 3]
+         + 0.1 * rng.randn(N) > 0).astype(np.float64)
+    g = jnp.asarray((0.5 - y).astype(np.float32) * 2)
+    h = jnp.asarray(np.full(N, 0.5, np.float32))
+
+    def splits(t):
+        return sorted(zip(t.split_feature_inner[: t.num_leaves - 1],
+                          t.threshold_in_bin[: t.num_leaves - 1]))
+
+    cfg_v = config_from_params({
+        "objective": "binary", "num_leaves": 15, "verbose": -1,
+        "tree_learner": "voting", "top_k": F, "min_data_in_leaf": 20})
+    ds = RawDataset(X, y, config=cfg_v)
+    t_vote, _ = FusedTreeLearner(ds, cfg_v, make_mesh("voting")).train(g, h)
+    cfg_d = config_from_params({
+        "objective": "binary", "num_leaves": 15, "verbose": -1,
+        "tree_learner": "data", "min_data_in_leaf": 20})
+    t_data, _ = FusedTreeLearner(ds, cfg_d, make_mesh("data")).train(g, h)
+    assert splits(t_vote) == splits(t_data)
+    # small top_k: valid tree, PV-Tree approximation stays close
+    cfg_s = config_from_params({
+        "objective": "binary", "num_leaves": 15, "verbose": -1,
+        "tree_learner": "voting", "top_k": 5, "min_data_in_leaf": 20})
+    t_small, _ = FusedTreeLearner(ds, cfg_s, make_mesh("voting")).train(g, h)
+    assert t_small.num_leaves == t_data.num_leaves
+    shared = len(set(splits(t_small)) & set(splits(t_data)))
+    assert shared >= (t_data.num_leaves - 1) // 2
+
+
 def test_end_to_end_data_parallel(binary_example):
     X, y, Xt, yt = binary_example
     params = {"objective": "binary", "metric": "binary_logloss",
