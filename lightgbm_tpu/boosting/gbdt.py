@@ -89,6 +89,9 @@ class GBDT:
             if m is not None:
                 m.init(train_set.metadata, self.num_data)
                 self.train_metrics.append(m)
+        # pipelined-tree state (see _train_one_iter_pipelined)
+        self._pending = None
+        self._pending_stop = False
         # bagging state
         self.bag_rng = np.random.RandomState(cfg.bagging_seed)
         self.bag_idx = None
@@ -109,6 +112,7 @@ class GBDT:
                     self.class_default_output[k] = -np.log(1e-10)
 
     def add_valid(self, valid_set: Dataset, name: str) -> None:
+        self._flush_pending()
         cfg = self.config
         bins_np = valid_set.bins.astype(np.int32)
         pad = np.zeros((valid_set.num_features, 1), np.int32)
@@ -170,12 +174,79 @@ class GBDT:
         return self.objective.get_gradients(self.train_score.score)
 
     # ------------------------------------------------------------------
+    def _flush_pending(self) -> None:
+        """Materialize the pipelined tree from the previous iteration
+        (see train_one_iter: the packed-tree device→host transfer is
+        overlapped with the next iteration's work — on remote-attached
+        TPUs the fetch round-trip alone costs ~70 ms)."""
+        if getattr(self, "_pending", None) is None:
+            return
+        packed, slot, shrink = self._pending
+        self._pending = None
+        from ..learner.fused import unpack_tree_arrays, tree_arrays_to_host
+        arrs = unpack_tree_arrays(np.asarray(packed),
+                                  self.config.num_leaves)
+        tree = tree_arrays_to_host(arrs, self.train_set,
+                                   self.config.num_leaves)
+        tree.apply_shrinkage(shrink)
+        self.models[slot] = tree
+        if tree.num_leaves <= 1:
+            self._pending_stop = True
+
+    def _can_pipeline(self, is_eval: bool) -> bool:
+        return (self.K == 1
+                and not self.valid_sets
+                and not is_eval
+                and hasattr(self.learner, "train_device")
+                and self.__class__.__name__ in ("GBDT", "GOSS"))
+
+    def _train_one_iter_pipelined(self) -> bool:
+        """Boosting iteration with a one-iteration-delayed tree fetch: the
+        packed tree's device→host transfer overlaps the NEXT iteration's
+        gradient/build/score work instead of stalling on the round-trip."""
+        from .. import profiling
+        self._flush_pending()
+        if getattr(self, "_pending_stop", False):
+            self._pending_stop = False
+            self.models.pop()
+            self.iter_ -= 1
+            import warnings
+            warnings.warn("Stopped training because there are no more "
+                          "leaves that meet the split requirements.")
+            return True
+        self._boost_from_average()
+        with profiling.phase("boosting"):
+            gradient, hessian = self.boosting_gradients()
+        with profiling.phase("bagging"):
+            self._bagging(self.iter_)
+        bag = (self.bag_idx
+               if self.need_bagging and self.bag_cnt < self.num_data
+               else None)
+        with profiling.phase("tree"):
+            packed, leaf_id, leaf_values = self.learner.train_device(
+                gradient[0], hessian[0], bag,
+                self.bag_cnt if bag is not None else None)
+        with profiling.phase("score"):
+            import jax.numpy as jnp
+            lv = jnp.clip(leaf_values * np.float32(self.shrinkage_rate),
+                          -100.0, 100.0)  # tree.h kMaxTreeOutput clamp
+            self.train_score.add_tree_by_leaf_id_dev(leaf_id, lv, 0)
+        packed.copy_to_host_async()
+        self.models.append(None)      # placeholder until _flush_pending
+        self._pending = (packed, len(self.models) - 1, self.shrinkage_rate)
+        self.iter_ += 1
+        return False
+
     def train_one_iter(self, gradient: Optional[jax.Array] = None,
                        hessian: Optional[jax.Array] = None,
                        is_eval: bool = False) -> bool:
         """One boosting iteration.  Returns True when training should stop
         (early stopping or no splittable leaves)."""
         from .. import profiling
+        if gradient is None and hessian is None \
+                and self._can_pipeline(is_eval):
+            return self._train_one_iter_pipelined()
+        self._flush_pending()
         self._boost_from_average()
         if gradient is None or hessian is None:
             with profiling.phase("boosting"):
@@ -229,6 +300,7 @@ class GBDT:
         return False
 
     def rollback_one_iter(self) -> None:
+        self._flush_pending()
         if self.iter_ <= 0:
             return
         for k in range(self.K):
@@ -242,6 +314,7 @@ class GBDT:
 
     # ------------------------------------------------------------------
     def eval_train(self) -> List[Tuple[str, str, float, bool]]:
+        self._flush_pending()
         out = []
         score = self.train_score.get()
         for m in self.train_metrics:
@@ -298,6 +371,7 @@ class GBDT:
         return (len(self.models) - extra) // self.K
 
     def predict_raw(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
+        self._flush_pending()
         """Raw scores for a dense matrix (rows, raw features) -> [N] or [N, K]."""
         X = np.ascontiguousarray(np.asarray(X, np.float64))
         n = X.shape[0]
@@ -315,6 +389,7 @@ class GBDT:
 
     def predict_leaf_index(self, X: np.ndarray, num_iteration: int = -1
                            ) -> np.ndarray:
+        self._flush_pending()
         X = np.ascontiguousarray(np.asarray(X, np.float64))
         used = self._num_used_models(num_iteration)
         return np.stack([self.models[i].predict_leaf_index(X)
@@ -329,6 +404,7 @@ class GBDT:
 
     # ------------------------------------------------------------------
     def feature_importance(self) -> Dict[str, int]:
+        self._flush_pending()
         """Split-count importance (gbdt.cpp:850-872)."""
         cnt = np.zeros(self.max_feature_idx + 1, np.int64)
         for t in self.models:
@@ -343,6 +419,7 @@ class GBDT:
         return "tree"
 
     def save_model_to_string(self, num_iteration: int = -1) -> str:
+        self._flush_pending()
         """LightGBM-compatible model text (gbdt.cpp:694-738)."""
         buf = io.StringIO()
         buf.write(self.sub_model_name() + "\n")
@@ -418,6 +495,7 @@ class GBDT:
         self.iter_ = 0
 
     def to_json(self) -> Dict:
+        self._flush_pending()
         return {
             "name": self.sub_model_name(),
             "num_class": self.num_class,

@@ -98,6 +98,16 @@ class ScoreUpdater:
         self.score = self.score.at[tree_id].set(
             _add_from_leaf(self.score[tree_id], leaf_idx, lv))
 
+    def add_tree_by_leaf_id_dev(self, leaf_id: jax.Array,
+                                leaf_values: jax.Array, tree_id: int
+                                ) -> None:
+        """Leaf-partition score update with DEVICE leaf values (shrinkage
+        pre-applied) — no host tree needed; used by the pipelined
+        training path."""
+        self.score = self.score.at[tree_id].set(
+            _add_from_leaf(self.score[tree_id], leaf_id,
+                           leaf_values.astype(jnp.float32)))
+
     def add_tree_by_leaf_id(self, tree, leaf_id: jax.Array, tree_id: int
                             ) -> None:
         """Leaf-partition fast path for the training set

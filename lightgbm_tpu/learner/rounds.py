@@ -119,8 +119,10 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
         split_gain=jnp.zeros(L - 1, jnp.float32),
         internal_value=jnp.zeros(L - 1, jnp.float32),
         internal_count=jnp.zeros(L - 1, jnp.float32),
-        leaf_value=jnp.zeros(L, jnp.float32).at[0].set(
-            leaf_output(sum_g, sum_h, l1, l2)),
+        # leaf 0 stays 0.0 until a split assigns it: a tree that never
+        # splits must contribute zero score (the sync path discards such
+        # trees; the pipelined path applies leaf values before it can know)
+        leaf_value=jnp.zeros(L, jnp.float32),
         leaf_count=jnp.zeros(L, jnp.float32).at[0].set(cnt),
         leaf_depth=jnp.zeros(L, jnp.int32),
         num_leaves=jnp.int32(1),
@@ -302,6 +304,8 @@ class RoundsTreeLearner:
             bins_np = np.pad(bins_np, ((0, 0), (0, self.Np - self.N)))
         self._row_mask = np.pad(np.ones(self.N, np.float32),
                                 (0, self.Np - self.N))
+        self._row_mask_dev = None     # lazy device cache (no bagging path)
+        self._fmask_dev = None        # lazy device cache (no sampling path)
         self._base_fmask = np.ones(self.F, bool)
         cfg = config
         self.split_kw = make_split_kw(cfg)
@@ -355,15 +359,39 @@ class RoundsTreeLearner:
             return x
         return jnp.pad(x, (0, self.Np - self.N))
 
-    def train(self, grad: jax.Array, hess: jax.Array,
-              bag_idx: Optional[jax.Array] = None,
-              bag_count: Optional[int] = None) -> Tuple[Tree, jax.Array]:
-        mask = jnp.asarray(self._row_mask)
+    def _masks(self, bag_idx):
+        if self._row_mask_dev is None:
+            self._row_mask_dev = jnp.asarray(self._row_mask)
+        mask = self._row_mask_dev
         if bag_idx is not None:
             mask = jnp.zeros(self.Np, jnp.float32).at[bag_idx].set(
                 1.0, mode="drop") * mask
+        if self.config.feature_fraction < 1.0:
+            fmask = self._feature_mask()
+        else:
+            if self._fmask_dev is None:
+                self._fmask_dev = jnp.asarray(self._base_fmask)
+            fmask = self._fmask_dev
+        return mask, fmask
+
+    def train_device(self, grad: jax.Array, hess: jax.Array,
+                     bag_idx: Optional[jax.Array] = None,
+                     bag_count: Optional[int] = None):
+        """Device-only train: (packed tree vector, leaf_id) with NO
+        device→host sync — callers pipeline the tree fetch."""
+        from .fused import pack_tree_arrays
+        mask, fmask = self._masks(bag_idx)
         arrs, leaf_id = self._build(
             self.bins_dev, self._pad_rows(grad), self._pad_rows(hess), mask,
-            self.num_bins_dev, self.is_cat_dev, self._feature_mask())
+            self.num_bins_dev, self.is_cat_dev, fmask)
+        return pack_tree_arrays(arrs), leaf_id[: self.N], arrs.leaf_value
+
+    def train(self, grad: jax.Array, hess: jax.Array,
+              bag_idx: Optional[jax.Array] = None,
+              bag_count: Optional[int] = None) -> Tuple[Tree, jax.Array]:
+        mask, fmask = self._masks(bag_idx)
+        arrs, leaf_id = self._build(
+            self.bins_dev, self._pad_rows(grad), self._pad_rows(hess), mask,
+            self.num_bins_dev, self.is_cat_dev, fmask)
         tree = tree_arrays_to_host(arrs, self.dataset, self.config.num_leaves)
         return tree, leaf_id[: self.N]
