@@ -132,12 +132,13 @@ def _make_n_folds(full_data: Dataset, nfold: int, params, seed: int,
     rng = np.random.RandomState(seed)
     if stratified:
         label = np.asarray(full_data.get_label())
-        order = np.argsort(label, kind="stable")
         if shuffle:
-            # round-robin assignment over sorted labels keeps folds stratified
-            folds_idx = [order[i::nfold] for i in range(nfold)]
+            # random order within each label class, then round-robin:
+            # folds stay stratified but membership is randomized
+            order = np.lexsort((rng.permutation(num_data), label))
         else:
-            folds_idx = [order[i::nfold] for i in range(nfold)]
+            order = np.argsort(label, kind="stable")
+        folds_idx = [order[i::nfold] for i in range(nfold)]
     else:
         idx = np.arange(num_data)
         if shuffle:

@@ -68,8 +68,11 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
     B = num_bins_padded
     K = LEAVES_PER_BATCH
     n_chunks = (L + K - 1) // K
-    R = max_rounds if max_rounds > 0 else min(
-        L - 1, int(math.ceil(math.log2(max(L, 2)))) + 8)
+    # Termination is governed by the while_loop predicate (no positive gain
+    # or num_leaves reached); R is only a provably non-binding safety bound:
+    # any round that runs splits >=1 leaf, so L-1 rounds suffice even for a
+    # chain-shaped tree (serial_tree_learner.cpp:203-224 stopping rule).
+    R = max_rounds if max_rounds > 0 else L - 1
     skw = dict(split_kw)
     l1, l2 = skw["lambda_l1"], skw["lambda_l2"]
     binsf = bins.astype(jnp.int32)

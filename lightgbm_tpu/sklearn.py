@@ -231,11 +231,6 @@ class LGBMRegressor(LGBMModel):
     def __init__(self, objective: str = "regression", **kwargs):
         super().__init__(objective=objective, **kwargs)
 
-    def fit(self, X, y, **kwargs):  # noqa: D102
-        if callable(self.objective):
-            pass
-        return super().fit(X, y, **kwargs)
-
 
 class LGBMClassifier(LGBMModel):
     def __init__(self, objective: str = "binary", **kwargs):

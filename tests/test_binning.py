@@ -4,6 +4,8 @@ import numpy as np
 import jax.numpy as jnp
 import pytest
 
+pytestmark = pytest.mark.quick
+
 from lightgbm_tpu.binning import (find_bin, find_bin_mappers, BinMapper,
                                   NUMERICAL, CATEGORICAL)
 from lightgbm_tpu.ops.histogram import (hist_xla, hist_multileaf_masked)

@@ -54,6 +54,31 @@ def test_rounds_sharded_matches_unsharded(problem):
     assert _splits(tm) == _splits(tr)
 
 
+def test_rounds_chain_tree_reaches_num_leaves():
+    """Skewed data forcing a chain-shaped tree: each round can split only
+    one leaf (the one holding the exponential tail), so the tree needs
+    num_leaves-1 rounds.  Regression test for the old fixed round budget
+    R = min(L-1, ceil(log2 L)+8) that silently truncated such trees."""
+    n, L = 64, 16
+    X = np.arange(n, dtype=np.float64).reshape(-1, 1)
+    y = 1.6 ** np.arange(n)          # variance dominated by the top row
+    cfg = config_from_params({
+        "objective": "regression", "num_leaves": L, "min_data_in_leaf": 1,
+        "min_sum_hessian_in_leaf": 1e-3, "max_bin": 255, "verbose": -1})
+    ds = RawDataset(X, y, config=cfg)
+    g = jnp.asarray((0.0 - y).astype(np.float32))
+    h = jnp.asarray(np.ones(n, np.float32))
+    ts, _ = SerialTreeLearner(ds, cfg).train(g, h)
+    tr, _ = RoundsTreeLearner(ds, cfg, None).train(g, h)
+    assert ts.num_leaves == L        # exact leaf-wise fills the cap
+    assert tr.num_leaves == ts.num_leaves
+    depths = np.asarray(tr.leaf_depth[: tr.num_leaves])
+    np.testing.assert_array_equal(
+        np.sort(depths), np.sort(np.asarray(ts.leaf_depth[: ts.num_leaves])))
+    # deeper than the old cap (min(L-1, ceil(log2 L)+8) = 12 rounds) allowed
+    assert depths.max() > 12
+
+
 def test_rounds_respects_num_leaves_cap(problem):
     ds, cfg, g, h = problem
     cfg2 = config_from_params({
