@@ -193,10 +193,8 @@ class GBDT:
         if tree.num_leaves <= 1:
             self._pending_stop = True
 
-    def _can_pipeline(self, is_eval: bool) -> bool:
+    def _can_pipeline(self) -> bool:
         return (self.K == 1
-                and not self.valid_sets
-                and not is_eval
                 and hasattr(self.learner, "train_device")
                 and self.__class__.__name__ in ("GBDT", "GOSS"))
 
@@ -223,14 +221,18 @@ class GBDT:
                if self.need_bagging and self.bag_cnt < self.num_data
                else None)
         with profiling.phase("tree"):
-            packed, leaf_id, leaf_values = self.learner.train_device(
+            packed, leaf_id, arrs = self.learner.train_device(
                 gradient[0], hessian[0], bag,
                 self.bag_cnt if bag is not None else None)
         with profiling.phase("score"):
             import jax.numpy as jnp
-            lv = jnp.clip(leaf_values * np.float32(self.shrinkage_rate),
+            lv = jnp.clip(arrs.leaf_value * np.float32(self.shrinkage_rate),
                           -100.0, 100.0)  # tree.h kMaxTreeOutput clamp
             self.train_score.add_tree_by_leaf_id_dev(leaf_id, lv, 0)
+            # valid sets stay on the fast path too: traverse the device
+            # TreeArrays directly (no host tree, no pipeline stall)
+            for _, _, su, _ in self.valid_sets:
+                su.add_tree_arrays_dev(arrs, lv, 0)
         packed.copy_to_host_async()
         self.models.append(None)      # placeholder until _flush_pending
         self._pending = (packed, len(self.models) - 1, self.shrinkage_rate)
@@ -243,9 +245,12 @@ class GBDT:
         """One boosting iteration.  Returns True when training should stop
         (early stopping or no splittable leaves)."""
         from .. import profiling
-        if gradient is None and hessian is None \
-                and self._can_pipeline(is_eval):
-            return self._train_one_iter_pipelined()
+        if gradient is None and hessian is None and self._can_pipeline():
+            if self._train_one_iter_pipelined():
+                return True
+            if is_eval:
+                return self.eval_and_check_early_stopping()
+            return False
         self._flush_pending()
         self._boost_from_average()
         if gradient is None or hessian is None:
@@ -313,25 +318,34 @@ class GBDT:
         self.iter_ -= 1
 
     # ------------------------------------------------------------------
+    def _eval_one_set(self, set_name: str, su: ScoreUpdater,
+                      ms: List[Metric], out: List) -> None:
+        """Device metric kernels first (scalar fetch only); host fallback
+        fetches the score vector at most once per dataset."""
+        host_score = None
+        for m in ms:
+            res = m.eval_device(su.score, self.objective)
+            if res is None:
+                if host_score is None:
+                    host_score = su.get()
+                res = m.eval(host_score, self.objective)
+            for nm, v in res:
+                out.append((set_name, nm, v, m.factor_to_bigger_better > 0))
+
     def eval_train(self) -> List[Tuple[str, str, float, bool]]:
-        self._flush_pending()
-        out = []
-        score = self.train_score.get()
-        for m in self.train_metrics:
-            for nm, v in m.eval(score, self.objective):
-                out.append(("training", nm, v, m.factor_to_bigger_better > 0))
+        from .. import profiling
+        out: List = []
+        with profiling.phase("metric"):
+            self._eval_one_set("training", self.train_score,
+                               self.train_metrics, out)
         return out
 
     def eval_valid(self) -> List[Tuple[str, str, float, bool]]:
         from .. import profiling
-        out = []
+        out: List = []
         with profiling.phase("metric"):
             for name, _, su, ms in self.valid_sets:
-                score = su.get()
-                for m in ms:
-                    for nm, v in m.eval(score, self.objective):
-                        out.append((name, nm, v,
-                                    m.factor_to_bigger_better > 0))
+                self._eval_one_set(name, su, ms, out)
         return out
 
     def eval_and_check_early_stopping(self, results=None) -> bool:
@@ -355,6 +369,7 @@ class GBDT:
                 improved = True
         best_iter = max(v[1] for v in st.values())
         if self.iter_ - best_iter >= esr:
+            self._flush_pending()   # materialize before dropping models
             n_drop = (self.iter_ - best_iter) * self.K
             del self.models[-n_drop:]
             self.iter_ = best_iter

@@ -46,6 +46,39 @@ def predict_binned_leaf(bins_t: jax.Array, split_feature_inner: jax.Array,
 
 
 @jax.jit
+def traverse_tree_device(bins_t, split_feature, threshold_bin, is_cat,
+                         left_child, right_child, num_leaves) -> jax.Array:
+    """Leaf index per row from DEVICE tree arrays (learner TreeArrays) —
+    no host tree needed, so the pipelined training path can score valid
+    sets without waiting for the tree fetch.  A `while_loop` walks until
+    every row parked at a leaf (negative node), so cost tracks the actual
+    tree depth instead of a static worst-case bound."""
+    N = bins_t.shape[0] - 1
+    rows = jnp.arange(N)
+    # stump: everything is leaf 0 (node -1 == ~0) from the start
+    n0 = jnp.where(num_leaves < 2, jnp.int32(-1), jnp.int32(0))
+    node = jnp.full(N, n0, jnp.int32)
+    max_steps = split_feature.shape[0] + 1
+
+    def cond(st):
+        i, node = st
+        return (i < max_steps) & jnp.any(node >= 0)
+
+    def body(st):
+        i, node = st
+        nd = jnp.maximum(node, 0)
+        feat = split_feature[nd]
+        bv = bins_t[rows, feat].astype(jnp.int32)
+        t = threshold_bin[nd]
+        go_left = jnp.where(is_cat[nd], bv == t, bv <= t)
+        nxt = jnp.where(go_left, left_child[nd], right_child[nd])
+        return i + 1, jnp.where(node < 0, node, nxt)
+
+    _, node = jax.lax.while_loop(cond, body, (jnp.int32(0), node))
+    return ~node
+
+
+@jax.jit
 def _add_from_leaf(score_row, leaf_idx, leaf_values):
     return score_row + leaf_values[leaf_idx]
 
@@ -97,6 +130,18 @@ class ScoreUpdater:
                          ) * np.float32(scale)
         self.score = self.score.at[tree_id].set(
             _add_from_leaf(self.score[tree_id], leaf_idx, lv))
+
+    def add_tree_arrays_dev(self, arrs, leaf_values: jax.Array,
+                            tree_id: int) -> None:
+        """Whole-data score update from DEVICE TreeArrays (pipelined path
+        for datasets that don't have the training leaf_id — valid sets).
+        `leaf_values` carries shrinkage/clamp pre-applied."""
+        leaf_idx = traverse_tree_device(
+            self.bins_t, arrs.split_feature, arrs.threshold_bin,
+            arrs.is_cat, arrs.left_child, arrs.right_child, arrs.num_leaves)
+        self.score = self.score.at[tree_id].set(
+            _add_from_leaf(self.score[tree_id], leaf_idx,
+                           leaf_values.astype(jnp.float32)))
 
     def add_tree_by_leaf_id_dev(self, leaf_id: jax.Array,
                                 leaf_values: jax.Array, tree_id: int

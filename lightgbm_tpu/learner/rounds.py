@@ -380,14 +380,15 @@ class RoundsTreeLearner:
     def train_device(self, grad: jax.Array, hess: jax.Array,
                      bag_idx: Optional[jax.Array] = None,
                      bag_count: Optional[int] = None):
-        """Device-only train: (packed tree vector, leaf_id) with NO
-        device→host sync — callers pipeline the tree fetch."""
+        """Device-only train: (packed tree vector, leaf_id, TreeArrays)
+        with NO device→host sync — callers pipeline the tree fetch and can
+        score valid sets straight from the device TreeArrays."""
         from .fused import pack_tree_arrays
         mask, fmask = self._masks(bag_idx)
         arrs, leaf_id = self._build(
             self.bins_dev, self._pad_rows(grad), self._pad_rows(hess), mask,
             self.num_bins_dev, self.is_cat_dev, fmask)
-        return pack_tree_arrays(arrs), leaf_id[: self.N], arrs.leaf_value
+        return pack_tree_arrays(arrs), leaf_id[: self.N], arrs
 
     def train(self, grad: jax.Array, hess: jax.Array,
               bag_idx: Optional[jax.Array] = None,
