@@ -18,6 +18,7 @@ per-iteration flow is (1) one fused elementwise gradient program,
 from __future__ import annotations
 
 import io
+import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -56,6 +57,7 @@ class GBDT:
         self.feature_infos: List[str] = []
         self.max_feature_idx = 0
         self._early_stopping_state: Dict = {}
+        self._predict_stack_cache: Dict = {}
         if train_set is not None:
             self.reset_training_data(train_set, objective)
 
@@ -228,6 +230,11 @@ class GBDT:
             import jax.numpy as jnp
             lv = jnp.clip(arrs.leaf_value * np.float32(self.shrinkage_rate),
                           -100.0, 100.0)  # tree.h kMaxTreeOutput clamp
+            # a no-split tree must contribute zero score: the rounds
+            # learner guarantees leaf_value[0]==0 for stumps, but enforce
+            # it here so every train_device implementation is safe (the
+            # stump is popped next iteration with no score rollback)
+            lv = lv * (arrs.num_leaves >= 2)
             self.train_score.add_tree_by_leaf_id_dev(leaf_id, lv, 0)
             # valid sets stay on the fast path too: traverse the device
             # TreeArrays directly (no host tree, no pipeline stall)
@@ -385,12 +392,61 @@ class GBDT:
         extra = 1 if self.boost_from_average_used else 0
         return (len(self.models) - extra) // self.K
 
+    # batch-size/ensemble-size product above which prediction moves to the
+    # stacked device walk (ops/predict.py); small calls keep the host f64
+    # walk (no jit latency, reference-exact double comparisons)
+    _DEVICE_PREDICT_MIN_WORK = 2_000_000
+
+    def _predict_raw_device(self, X: np.ndarray, used: int) -> np.ndarray:
+        """Stacked-ensemble device predictor (predictor.hpp:24-159 is the
+        reference's parallel batch path; here all trees × all rows advance
+        one level per step on device).  f32 feature/threshold compares —
+        the same single-precision trade the reference GPU learner makes
+        (docs/GPU-Performance.md:130-134)."""
+        from ..ops.predict import stack_trees, predict_trees
+        import jax.numpy as jnp
+        n = X.shape[0]
+        out = np.zeros((self.K, n), np.float64)
+        CHUNK = 262_144
+        for k in range(self.K):
+            key = (used, k, len(self.models))
+            cached = self._predict_stack_cache.get(key)
+            if cached is None:
+                trees = [self.models[i] for i in range(used)
+                         if i % self.K == k]
+                if not trees:
+                    continue
+                stack = stack_trees(trees, binned=False)
+                depth = max((t.max_depth_grown for t in trees), default=1)
+                cached = (stack, max(depth, 1))
+                if len(self._predict_stack_cache) >= 4 * max(self.K, 1):
+                    self._predict_stack_cache.clear()
+                self._predict_stack_cache[key] = cached
+            stack, depth = cached
+            for a in range(0, n, CHUNK):
+                b = min(a + CHUNK, n)
+                chunk = X[a:b]
+                pad = 0
+                if b - a < CHUNK and n > CHUNK:
+                    pad = CHUNK - (b - a)   # keep one compiled shape
+                    chunk = np.pad(chunk, ((0, pad), (0, 0)))
+                vals = predict_trees(stack, jnp.asarray(chunk, jnp.float32),
+                                     depth=depth)
+                out[k, a:b] = np.asarray(vals)[: b - a]
+        return out[0] if self.K == 1 else out.T
+
     def predict_raw(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
         self._flush_pending()
         """Raw scores for a dense matrix (rows, raw features) -> [N] or [N, K]."""
         X = np.ascontiguousarray(np.asarray(X, np.float64))
         n = X.shape[0]
         used = self._num_used_models(num_iteration)
+        force = os.environ.get("LIGHTGBM_TPU_DEVICE_PREDICT", "")
+        use_dev = (force != "0"
+                   and (force == "1"
+                        or n * max(used, 1) >= self._DEVICE_PREDICT_MIN_WORK))
+        if use_dev and used > 0:
+            return self._predict_raw_device(X, used)
         out = np.zeros((self.K, n), np.float64)
         for i in range(used):
             out[i % self.K] += self.models[i].predict_raw(X)

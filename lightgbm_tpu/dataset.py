@@ -425,7 +425,85 @@ class Dataset:
             label_idx = int(cfg.label_column)
         X, y, names = parse_text_file(path, cfg.has_header, label_idx)
         md = Metadata.load_side_files(path, len(y))
-        cats = _parse_categorical_column(cfg.categorical_column, names, X.shape[1])
+
+        # ---- in-file column selectors (dataset_loader.cpp:22-157) ----------
+        # Indices count the FILE's columns (label included), the reference
+        # CSV/TSV convention; `name:` selectors need has_header.
+        def _resolve(spec: str, what: str) -> Optional[int]:
+            spec = spec.strip()
+            if not spec:
+                return None
+            if spec.startswith("name:"):
+                if not names:
+                    raise ValueError(
+                        f"{what}={spec} needs has_header=true with a header")
+                nm = spec[5:].strip()
+                if nm not in names:
+                    raise ValueError(f"{what}: no column named {nm!r}")
+                return names.index(nm)
+            return int(spec)
+
+        def _xcol(c: int, what: str) -> int:
+            """file column index -> X column index (label removed)."""
+            if c == label_idx:
+                raise ValueError(f"{what} column {c} is the label column")
+            if not 0 <= c <= X.shape[1]:
+                raise ValueError(f"{what} column {c} out of range")
+            return c - 1 if c > label_idx else c
+
+        drop: List[int] = []
+        wi = _resolve(cfg.weight_column, "weight_column")
+        if wi is not None:
+            xw = _xcol(wi, "weight_column")
+            if md.weights is not None:
+                import warnings
+                warnings.warn("weight_column overrides the .weight side file")
+            md.weights = X[:, xw].astype(np.float32)
+            drop.append(xw)
+        gi = _resolve(cfg.group_column, "group_column")
+        if gi is not None:
+            xg = _xcol(gi, "group_column")
+            qid = X[:, xg]
+            # per-row query ids -> boundaries (metadata.cpp group column
+            # handling): rows of one query must be contiguous
+            change = np.nonzero(qid[1:] != qid[:-1])[0] + 1
+            starts = np.concatenate([[0], change])
+            if len(np.unique(qid)) != len(starts):
+                raise ValueError(
+                    "group_column: rows of the same query must be "
+                    "contiguous in the data file")
+            if md.query_boundaries is not None:
+                import warnings
+                warnings.warn("group_column overrides the .query side file")
+            md.query_boundaries = np.concatenate(
+                [starts, [len(qid)]]).astype(np.int32)
+            drop.append(xg)
+        ign = cfg.ignore_column.strip()
+        if ign.startswith("name:"):
+            # `name:` prefixes the WHOLE comma-separated list
+            # (dataset_loader.cpp ignore-column parsing)
+            for nm in ign[5:].split(","):
+                ci = _resolve(f"name:{nm.strip()}", "ignore_column")
+                if ci is not None:
+                    drop.append(_xcol(ci, "ignore_column"))
+        elif ign:
+            for tok in ign.replace(",", " ").split():
+                drop.append(_xcol(int(tok), "ignore_column"))
+
+        x_names = None
+        if names:
+            if len(names) == X.shape[1] + 1:
+                x_names = [nm for c, nm in enumerate(names) if c != label_idx]
+            elif len(names) == X.shape[1]:
+                x_names = list(names)
+        if drop:
+            keep = [c for c in range(X.shape[1]) if c not in set(drop)]
+            X = X[:, keep]
+            if x_names is not None:
+                x_names = [x_names[c] for c in keep]
+
+        cats = _parse_categorical_column(cfg.categorical_column, x_names,
+                                         X.shape[1])
         ds = Dataset(X, y, cfg, reference=reference, metadata=md,
-                     feature_names=names, categorical_feature=cats)
+                     feature_names=x_names, categorical_feature=cats)
         return ds

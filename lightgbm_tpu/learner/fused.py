@@ -75,7 +75,8 @@ def build_tree(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
                feature_shard_size: int = 0,
                input_dtype: str = "float32",
                voting_k: int = 0,
-               num_machines: int = 1):
+               num_machines: int = 1,
+               cache_parent_hist: bool = True):
     """Grow one tree; runs per-shard inside `shard_map` (or standalone when
     both axes are None).
 
@@ -195,7 +196,12 @@ def build_tree(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
     leaf_depth = jnp.zeros(L, jnp.int32)
     leaf_parent = jnp.full(L, -1, jnp.int32)
     leaf_side = jnp.zeros(L, jnp.int32)
-    leaf_hist = jnp.zeros((L, Floc, 3, B), jnp.float32).at[0].set(hist0)
+    # leaf-hist cache for the parent-subtraction trick; dropped when the
+    # pool budget binds (reference HistogramPool, feature_histogram.hpp:
+    # 313-475) — both children are then histogrammed directly
+    leaf_hist = (jnp.zeros((L, Floc, 3, B), jnp.float32).at[0].set(hist0)
+                 if cache_parent_hist
+                 else jnp.zeros((1, 1, 1, 1), jnp.float32))
 
     arrs = TreeArrays(
         split_feature=jnp.zeros(L - 1, jnp.int32),
@@ -247,9 +253,13 @@ def build_tree(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
         # ---- smaller child histogram + larger by subtraction --------------
         # (serial_tree_learner.cpp smaller/larger trick; do=False → zero
         # mask → zero hist, state select below keeps everything unchanged)
+        large_leaf = jnp.where(small_is_left, new_leaf, best_leaf)
         msk = row_mask * (leaf_id2 == small_leaf) * do
         hist_small = make_hist(msk)
-        hist_large = leaf_hist[best_leaf] - hist_small
+        if cache_parent_hist:
+            hist_large = leaf_hist[best_leaf] - hist_small
+        else:
+            hist_large = make_hist(row_mask * (leaf_id2 == large_leaf) * do)
 
         child_depth = leaf_depth[best_leaf] + 1
         small_sums = jnp.where(small_is_left, l_sums, r_sums)
@@ -258,8 +268,13 @@ def build_tree(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
         rec_large = find_best(hist_large, large_sums)
         rec_left = jnp.where(small_is_left, rec_small, rec_large)
         rec_right = jnp.where(small_is_left, rec_large, rec_small)
-        hist_left = jnp.where(small_is_left, hist_small, hist_large)
-        hist_right = jnp.where(small_is_left, hist_large, hist_small)
+        if cache_parent_hist:
+            hist_left = jnp.where(small_is_left, hist_small, hist_large)
+            hist_right = jnp.where(small_is_left, hist_large, hist_small)
+            leaf_hist_new = leaf_hist.at[best_leaf].set(hist_left).at[
+                new_leaf].set(hist_right)
+        else:
+            leaf_hist_new = leaf_hist
 
         # ---- tree arrays (Tree::Split, tree.cpp:52-97) --------------------
         pn = leaf_parent[best_leaf]
@@ -295,8 +310,7 @@ def build_tree(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
                 child_depth),
             leaf_parent.at[best_leaf].set(node).at[new_leaf].set(node),
             leaf_side.at[best_leaf].set(0).at[new_leaf].set(1),
-            leaf_hist.at[best_leaf].set(hist_left).at[new_leaf].set(
-                hist_right),
+            leaf_hist_new,
             arrs2,
         )
         old_st = (leaf_id, leaf_best, leaf_depth, leaf_parent,
@@ -416,12 +430,20 @@ class FusedTreeLearner:
 
         voting = (getattr(cfg, "tree_learner", "") == "voting"
                   and self.dd > 1)
+        # histogram-memory bound per device (reference HistogramPool,
+        # feature_histogram.hpp:313-475); Floc is this shard's feature count
+        hist_cache_bytes = (4 * cfg.num_leaves * (self.Fp // self.df)
+                            * 3 * self.B)
+        pool_budget = (cfg.histogram_pool_size * 1e6
+                       if cfg.histogram_pool_size > 0 else 1.5e9)
+        self.cache_parent_hist = hist_cache_bytes <= pool_budget
         kw = dict(num_leaves=cfg.num_leaves, num_bins_padded=self.B,
                   split_kw=self.split_kw, max_depth=int(cfg.max_depth),
                   min_data_in_leaf=int(cfg.min_data_in_leaf),
                   min_sum_hessian_in_leaf=float(cfg.min_sum_hessian_in_leaf),
                   voting_k=int(cfg.top_k) if voting else 0,
                   num_machines=self.dd,
+                  cache_parent_hist=self.cache_parent_hist,
                   input_dtype=getattr(cfg, "histogram_dtype", "float32"))
         if mesh is None:
             fn = functools.partial(build_tree, **kw)

@@ -60,9 +60,17 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
                       data_axis: Optional[str] = None,
                       backend: str = "xla",
                       input_dtype: str = "float32",
-                      max_rounds: int = 0):
+                      max_rounds: int = 0,
+                      cache_parent_hist: bool = True):
     """Grow one tree in batched rounds.  Shapes as learner/fused.build_tree.
-    Returns (TreeArrays, leaf_id)."""
+    Returns (TreeArrays, leaf_id).
+
+    cache_parent_hist=False bounds tree-state memory (the analog of the
+    reference HistogramPool cap, feature_histogram.hpp:313-475): instead
+    of keeping every leaf's [F, 3, B] histogram for the parent-subtraction
+    trick, BOTH children are histogrammed directly — 2x histogram passes
+    per round, O(1) leaf-hist memory.  The learner picks this mode when
+    L*F*3*B*4 bytes exceeds the histogram_pool_size budget."""
     F, Nloc = bins.shape
     L = num_leaves
     B = num_bins_padded
@@ -111,7 +119,9 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
     leaf_depth = jnp.zeros(L, jnp.int32)
     leaf_parent = jnp.full(L, -1, jnp.int32)
     leaf_side = jnp.zeros(L, jnp.int32)
-    leaf_hist = jnp.zeros((L, F, 3, B), jnp.float32).at[0].set(hist0)
+    leaf_hist = (jnp.zeros((L, F, 3, B), jnp.float32).at[0].set(hist0)
+                 if cache_parent_hist
+                 else jnp.zeros((1, 1, 1, 1), jnp.float32))
 
     arrs = TreeArrays(
         split_feature=jnp.zeros(L - 1, jnp.int32),
@@ -227,6 +237,7 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
         # ---- batched smaller-child histograms -----------------------------
         small_is_left = l_sums[:, 2] <= r_sums[:, 2]
         small_leaf = jnp.where(small_is_left, pl_, new_leaf)
+        large_leaf = jnp.where(small_is_left, new_leaf, pl_)
         small_sums = jnp.where(small_is_left[:, None], l_sums, r_sums)
         large_sums = jnp.where(small_is_left[:, None], r_sums, l_sums)
 
@@ -245,20 +256,29 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
                     binsf, leaf_id2, gh8, slv, num_bins_padded=B,
                     backend=backend, input_dtype=input_dtype)
                 h_small = _psum(h_small, data_axis)          # [Kc, F, 3, B]
-                h_large = leaf_hist2[pl_[s:s + Kc]] - h_small
+                if cache_parent_hist:
+                    h_large = leaf_hist2[pl_[s:s + Kc]] - h_small
+                else:
+                    llv = jnp.where(dk, large_leaf[s:s + Kc], -1)
+                    h_large = _psum(hist_multileaf_masked(
+                        binsf, leaf_id2, gh8, llv, num_bins_padded=B,
+                        backend=backend, input_dtype=input_dtype), data_axis)
                 rec_s = find_best_batch(h_small, small_sums[s:s + Kc])
                 rec_l = find_best_batch(h_large, large_sums[s:s + Kc])
                 sil = small_is_left[s:s + Kc, None]
                 recL = jnp.where(sil, rec_s, rec_l)
                 recR = jnp.where(sil, rec_l, rec_s)
-                hL = jnp.where(sil[:, :, None, None], h_small, h_large)
-                hR = jnp.where(sil[:, :, None, None], h_large, h_small)
                 li = jnp.where(dk, pl_[s:s + Kc], L)
                 ni = jnp.where(dk, new_leaf[s:s + Kc], L)
                 lb = leaf_best2.at[li].set(recL, mode="drop").at[ni].set(
                     recR, mode="drop")
-                lh = leaf_hist2.at[li].set(hL, mode="drop").at[ni].set(
-                    hR, mode="drop")
+                if cache_parent_hist:
+                    hL = jnp.where(sil[:, :, None, None], h_small, h_large)
+                    hR = jnp.where(sil[:, :, None, None], h_large, h_small)
+                    lh = leaf_hist2.at[li].set(hL, mode="drop").at[ni].set(
+                        hR, mode="drop")
+                else:
+                    lh = leaf_hist2
                 return lb, lh
 
             def skip_chunk(args):
@@ -315,11 +335,20 @@ class RoundsTreeLearner:
         self._feat_rng = np.random.RandomState(cfg.feature_fraction_seed)
         backend = ("pallas" if jax.default_backend() == "tpu" else "xla")
 
+        # histogram-memory bound (reference HistogramPool,
+        # feature_histogram.hpp:313-475): when the per-leaf histogram cache
+        # would exceed the pool budget, grow with direct child histograms
+        # instead (2x hist passes, O(1) leaf-hist memory)
+        hist_cache_bytes = 4 * cfg.num_leaves * self.F * 3 * self.B
+        pool_budget = (cfg.histogram_pool_size * 1e6
+                       if cfg.histogram_pool_size > 0 else 1.5e9)
+        self.cache_parent_hist = hist_cache_bytes <= pool_budget
         kw = dict(num_leaves=cfg.num_leaves, num_bins_padded=self.B,
                   split_kw=self.split_kw, max_depth=int(cfg.max_depth),
                   min_data_in_leaf=int(cfg.min_data_in_leaf),
                   min_sum_hessian_in_leaf=float(cfg.min_sum_hessian_in_leaf),
                   backend=backend,
+                  cache_parent_hist=self.cache_parent_hist,
                   input_dtype=getattr(cfg, "histogram_dtype", "float32"))
         if mesh is None:
             self._build = jax.jit(functools.partial(build_tree_rounds, **kw))

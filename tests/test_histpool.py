@@ -1,0 +1,61 @@
+"""Histogram-memory bounding (reference HistogramPool LRU cap,
+feature_histogram.hpp:313-475).
+
+The TPU learners keep a [num_leaves, F, 3, B] per-leaf histogram cache for
+the parent-subtraction trick; when that exceeds the histogram_pool_size
+budget they switch to direct child histograms (2x hist passes, O(1)
+leaf-hist memory).  Both modes must grow the same trees.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.dataset import Dataset as InnerDataset
+from lightgbm_tpu.learner.rounds import RoundsTreeLearner
+
+
+@pytest.fixture(scope="module")
+def xy():
+    rng = np.random.RandomState(0)
+    X = rng.randn(3000, 10)
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2]
+         + 0.3 * rng.randn(3000) > 0).astype(float)
+    return X, y
+
+
+def _train(X, y, extra):
+    params = {"objective": "binary", "num_leaves": 31, "verbose": -1,
+              "tree_growth": "rounds", **extra}
+    return lgb.train(params, lgb.Dataset(X, y), num_boost_round=6)
+
+
+def test_nocache_mode_matches_cache_mode(xy):
+    X, y = xy
+    b1 = _train(X, y, {})
+    b2 = _train(X, y, {"histogram_pool_size": 0.001})  # force bounded mode
+    assert b1._gbdt.learner.cache_parent_hist
+    assert not b2._gbdt.learner.cache_parent_hist
+    assert np.abs(b1.predict(X) - b2.predict(X)).max() < 1e-4
+    assert ([t.num_leaves for t in b1._gbdt.models]
+            == [t.num_leaves for t in b2._gbdt.models])
+
+
+@pytest.mark.quick
+def test_epsilon_shape_selects_bounded_path():
+    """At Epsilon width (F=2000, 255 leaves) the learner honors
+    histogram_pool_size: a tight budget selects the bounded path, a
+    roomy one keeps the cache; and at the full Epsilon geometry
+    (B=256) the DEFAULT budget already forces the bounded path."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 2000)
+    ds = InnerDataset(X, rng.rand(64))
+    tight = RoundsTreeLearner(ds, Config(num_leaves=255,
+                                         histogram_pool_size=50.0))
+    assert not tight.cache_parent_hist
+    roomy = RoundsTreeLearner(ds, Config(num_leaves=255,
+                                         histogram_pool_size=4000.0))
+    assert roomy.cache_parent_hist
+    # full Epsilon geometry: [255 leaves, 2000 features, 3, 256 bins] f32
+    # = 1.57 GB > the 1.5 GB default budget
+    assert 4 * 255 * 2000 * 3 * 256 > 1.5e9
