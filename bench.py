@@ -7,11 +7,14 @@ docs/GPU-Performance.md:77-84) trained with the north-star config
 min_sum_hessian_in_leaf=100 — BASELINE.md).
 
 Metric: training seconds per boosting iteration on the default JAX
-backend (the real TPU chip under the driver).  `vs_baseline` is
+backend (the real TPU chip under the driver), at the FULL north-star
+shape (10.5M rows) by default.  `vs_baseline` is
 baseline_seconds_per_iter / our_seconds_per_iter (higher is better, >1
-means faster than baseline) against a measured run of the COMPILED
-REFERENCE binary on the same machine/data if `.bench/baseline.json`
-exists (see .bench/make_baseline.py), else 0.0 (no baseline measured).
+means faster than baseline) against the COMMITTED measurement of the
+compiled reference binary on this machine at the same shape
+(baseline_measured.json; regenerate via .bench/run_baseline_500.py).
+The JSON line also carries the 500-iteration accuracy evidence from
+northstar_measured.json when present.
 """
 import json
 import os
@@ -20,9 +23,9 @@ import time
 
 import numpy as np
 
-ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
-ITERS = int(os.environ.get("BENCH_ITERS", 30))
-WARMUP = int(os.environ.get("BENCH_WARMUP", 2))
+ROWS = int(os.environ.get("BENCH_ROWS", 10_500_000))
+ITERS = int(os.environ.get("BENCH_ITERS", 60))
+WARMUP = int(os.environ.get("BENCH_WARMUP", 3))
 LEAVES = int(os.environ.get("BENCH_LEAVES", 255))
 
 
@@ -61,22 +64,42 @@ def main():
     dt = time.perf_counter() - t0
     s_per_iter = dt / ITERS
 
-    base_file = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             ".bench", "baseline.json")
+    root = os.path.dirname(os.path.abspath(__file__))
     vs = 0.0
-    if os.path.exists(base_file):
-        with open(base_file) as f:
-            base = json.load(f)
-        if base.get("rows") == ROWS and base.get("num_leaves") == LEAVES:
-            vs = base["seconds_per_iter"] / s_per_iter
+    # tracked baseline (baseline_measured.json): the reference binary
+    # measured on this machine at the north-star shape — see the file for
+    # provenance.  Steady-state s/iter is the fair comparison: this bench
+    # window is also post-compile steady state.
+    tracked = os.path.join(root, "baseline_measured.json")
+    if ROWS == 10_500_000 and LEAVES == 255 and os.path.exists(tracked):
+        ref = json.load(open(tracked)).get("measured", {})
+        if ref.get("ref_seconds_per_iter_steady_state"):
+            vs = ref["ref_seconds_per_iter_steady_state"] / s_per_iter
+    if vs == 0.0:
+        base_file = os.path.join(root, ".bench", "baseline.json")
+        if os.path.exists(base_file):
+            with open(base_file) as f:
+                base = json.load(f)
+            if base.get("rows") == ROWS and base.get("num_leaves") == LEAVES:
+                vs = base["seconds_per_iter"] / s_per_iter
 
-    print(json.dumps({
+    out = {
         "metric": f"synthetic-higgs {ROWS}x28 gbdt {LEAVES} leaves, "
                   "255 bins: train seconds/iter",
         "value": round(s_per_iter, 4),
         "unit": "s/iter",
         "vs_baseline": round(vs, 4),
-    }))
+    }
+    # full 500-iteration accuracy evidence (scripts/run_northstar.py)
+    ns_file = os.path.join(root, "northstar_measured.json")
+    if os.path.exists(ns_file):
+        ns = json.load(open(ns_file))
+        if ns.get("rows") == 10_500_000 and ns.get("iters") == 500:
+            out["northstar_500iter_auc"] = ns.get("test_auc")
+            out["northstar_auc_delta_vs_ref"] = ns.get("auc_delta_vs_ref")
+            out["northstar_speedup_vs_ref"] = ns.get(
+                "speedup_vs_ref_same_host")
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

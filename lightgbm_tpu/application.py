@@ -67,6 +67,14 @@ class Application:
         cfg = self.config
         if not cfg.data:
             raise LightGBMError("no training data: set data=<file>")
+        # multi-host bootstrap BEFORE any device use — the analog of the
+        # reference's Network::Init at InitTrain (application.cpp:185-197)
+        if cfg.num_machines > 1:
+            from .distributed import maybe_init_from_config
+            if maybe_init_from_config(cfg):
+                import jax
+                _log(cfg, f"initialized {cfg.num_machines}-process world, "
+                          f"{len(jax.devices())} global devices")
         t0 = time.time()
         train_raw = RawDataset.from_file(cfg.data, cfg)
         if cfg.is_save_binary_file and not RawDataset._is_binary_file(

@@ -1,0 +1,139 @@
+"""Multi-host bootstrap: the reference's Network::Init for the TPU world.
+
+The reference brings up a TCP/MPI mesh from `num_machines` +
+`machine_list_file` (+ `local_listen_port`) at training start
+(/root/reference/src/application/application.cpp:185-197,
+src/network/linkers_socket.cpp:73-110: "ip port" lines, optional
+`rank=<n>` override, rank otherwise assigned by list order).
+
+On TPU the transport is XLA's ICI/DCN collectives; what remains of the
+network layer is PROCESS bootstrap: every host calls
+`jax.distributed.initialize(coordinator, num_processes, process_id)`, after
+which `jax.devices()` is the GLOBAL device list and the mesh learners
+(learner/fused.py make_mesh) shard over all hosts' chips with zero further
+changes — psum/all_gather ride ICI within a slice and DCN across slices.
+
+Launch recipe (2 hosts x 4 chips each):
+    # mlist.txt on both hosts:
+    #   10.0.0.1 12400
+    #   10.0.0.2 12400
+    host0$ python -m lightgbm_tpu config=train.conf num_machines=2 \
+               machine_list_file=mlist.txt        # rank inferred: local ip
+    host1$ python -m lightgbm_tpu config=train.conf num_machines=2 \
+               machine_list_file=mlist.txt
+    # rank can be forced per host: LIGHTGBM_TPU_MACHINE_RANK=1 or a
+    # `rank=1` suffix on the machine line, like the reference's parser.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import warnings
+from typing import List, Optional, Tuple
+
+_initialized = False
+
+
+def parse_machine_list(path: str) -> List[Tuple[str, int, Optional[int]]]:
+    """`ip port [rank=<n>]` per line (linkers_socket.cpp:73-110)."""
+    out: List[Tuple[str, int, Optional[int]]] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            rank = None
+            toks = []
+            for t in line.replace(",", " ").split():
+                if t.startswith("rank="):
+                    rank = int(t[5:])
+                else:
+                    toks.append(t)
+            if len(toks) < 2:
+                raise ValueError(
+                    f"machine_list line needs 'ip port': {line!r}")
+            out.append((toks[0], int(toks[1]), rank))
+    return out
+
+
+def _local_addresses() -> List[str]:
+    addrs = {"127.0.0.1", "localhost"}
+    try:
+        hostname = socket.gethostname()
+        addrs.add(hostname)
+        for info in socket.getaddrinfo(hostname, None):
+            addrs.add(info[4][0])
+    except OSError:
+        pass
+    return list(addrs)
+
+
+def resolve_rank(machines: List[Tuple[str, int, Optional[int]]]) -> int:
+    """This process's rank: env override, then explicit rank= entries,
+    then local-address match (the reference matches the local ip against
+    the list the same way, linkers_socket.cpp:84-103)."""
+    env = os.environ.get("LIGHTGBM_TPU_MACHINE_RANK")
+    if env is not None:
+        return int(env)
+    local = set(_local_addresses())
+    for i, (ip, _port, rank) in enumerate(machines):
+        if ip in local:
+            return rank if rank is not None else i
+    raise ValueError(
+        "cannot determine this machine's rank: none of the machine_list "
+        "addresses are local; set LIGHTGBM_TPU_MACHINE_RANK")
+
+
+def init_distributed(num_machines: int, machine_list_file: str = "",
+                     local_listen_port: int = 12400) -> bool:
+    """Bring up the multi-process JAX runtime.  Returns True if a
+    multi-host world was initialized (idempotent; False for single-host).
+
+    Maps the reference config exactly: `num_machines` processes, the
+    coordinator is the FIRST machine in the list (reference rank 0), and
+    `local_listen_port` is the fallback port when no list file is given
+    (single-host multi-process testing: coordinator on localhost)."""
+    global _initialized
+    if num_machines <= 1:
+        return False
+    if _initialized:
+        return True
+    import jax
+    if machine_list_file:
+        machines = parse_machine_list(machine_list_file)
+        if len(machines) != num_machines:
+            raise ValueError(
+                f"machine_list_file has {len(machines)} entries, "
+                f"num_machines={num_machines}")
+        rank = resolve_rank(machines)
+        # the coordinator is the machine whose EFFECTIVE rank is 0 —
+        # rank= overrides can move rank 0 away from the first list line
+        coord_machine = machines[0]
+        for i, m in enumerate(machines):
+            eff = m[2] if m[2] is not None else i
+            if eff == 0:
+                coord_machine = m
+                break
+        coord = f"{coord_machine[0]}:{coord_machine[1]}"
+    else:
+        rank_env = os.environ.get("LIGHTGBM_TPU_MACHINE_RANK")
+        if rank_env is None:
+            warnings.warn(
+                "num_machines>1 without machine_list_file or "
+                "LIGHTGBM_TPU_MACHINE_RANK: assuming single-host test "
+                "mode, skipping jax.distributed")
+            return False
+        rank = int(rank_env)
+        coord = f"127.0.0.1:{local_listen_port}"
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=num_machines,
+                               process_id=rank)
+    _initialized = True
+    return True
+
+
+def maybe_init_from_config(cfg) -> bool:
+    """Application entry (application.cpp:185-197 Network::Init analog)."""
+    return init_distributed(int(getattr(cfg, "num_machines", 0) or 0),
+                            getattr(cfg, "machine_list_file", ""),
+                            int(getattr(cfg, "local_listen_port", 12400)))

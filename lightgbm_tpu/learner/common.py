@@ -31,3 +31,15 @@ def sentinel_bins_t(dataset) -> np.ndarray:
     bins_np = dataset.bins.astype(np.int32)
     pad = np.zeros((dataset.num_features, 1), np.int32)
     return np.concatenate([bins_np, pad], axis=1).T.copy()
+
+
+def use_parent_hist_cache(cfg: Config, num_features: int,
+                          num_bins_padded: int) -> bool:
+    """Keep the [num_leaves, F, 3, B] per-leaf histogram cache for the
+    parent-subtraction trick only while it fits the pool budget
+    (reference HistogramPool cap, feature_histogram.hpp:313-475);
+    otherwise learners histogram both children directly."""
+    hist_cache_bytes = 4 * cfg.num_leaves * num_features * 3 * num_bins_padded
+    budget = (cfg.histogram_pool_size * 1e6
+              if cfg.histogram_pool_size > 0 else 1.5e9)
+    return hist_cache_bytes <= budget

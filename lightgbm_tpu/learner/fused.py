@@ -36,7 +36,8 @@ import numpy as np
 
 from ..config import Config
 from ..dataset import Dataset
-from .common import make_split_kw, padded_bin_count, sentinel_bins_t
+from .common import (make_split_kw, padded_bin_count, sentinel_bins_t,
+                     use_parent_hist_cache)
 from ..ops.histogram import histogram_full_masked
 from ..ops.split import best_split, leaf_output
 from ..tree import Tree, NUMERICAL_DECISION, CATEGORICAL_DECISION
@@ -430,13 +431,9 @@ class FusedTreeLearner:
 
         voting = (getattr(cfg, "tree_learner", "") == "voting"
                   and self.dd > 1)
-        # histogram-memory bound per device (reference HistogramPool,
-        # feature_histogram.hpp:313-475); Floc is this shard's feature count
-        hist_cache_bytes = (4 * cfg.num_leaves * (self.Fp // self.df)
-                            * 3 * self.B)
-        pool_budget = (cfg.histogram_pool_size * 1e6
-                       if cfg.histogram_pool_size > 0 else 1.5e9)
-        self.cache_parent_hist = hist_cache_bytes <= pool_budget
+        # histogram-memory bound (reference HistogramPool analog); the
+        # feature count is this shard's local share
+        self.cache_parent_hist = use_parent_hist_cache(cfg, (self.Fp // self.df), self.B)
         kw = dict(num_leaves=cfg.num_leaves, num_bins_padded=self.B,
                   split_kw=self.split_kw, max_depth=int(cfg.max_depth),
                   min_data_in_leaf=int(cfg.min_data_in_leaf),

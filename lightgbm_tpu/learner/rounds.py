@@ -39,7 +39,8 @@ import numpy as np
 
 from ..config import Config
 from ..dataset import Dataset
-from .common import make_split_kw, padded_bin_count, sentinel_bins_t
+from .common import (make_split_kw, padded_bin_count, sentinel_bins_t,
+                     use_parent_hist_cache)
 from .fused import TreeArrays, tree_arrays_to_host
 from ..ops.histogram import hist_multileaf_masked
 from ..ops.split import best_split, leaf_output
@@ -335,14 +336,9 @@ class RoundsTreeLearner:
         self._feat_rng = np.random.RandomState(cfg.feature_fraction_seed)
         backend = ("pallas" if jax.default_backend() == "tpu" else "xla")
 
-        # histogram-memory bound (reference HistogramPool,
-        # feature_histogram.hpp:313-475): when the per-leaf histogram cache
-        # would exceed the pool budget, grow with direct child histograms
-        # instead (2x hist passes, O(1) leaf-hist memory)
-        hist_cache_bytes = 4 * cfg.num_leaves * self.F * 3 * self.B
-        pool_budget = (cfg.histogram_pool_size * 1e6
-                       if cfg.histogram_pool_size > 0 else 1.5e9)
-        self.cache_parent_hist = hist_cache_bytes <= pool_budget
+        # histogram-memory bound (reference HistogramPool analog); the
+        # feature count is this shard's local share
+        self.cache_parent_hist = use_parent_hist_cache(cfg, self.F, self.B)
         kw = dict(num_leaves=cfg.num_leaves, num_bins_padded=self.B,
                   split_kw=self.split_kw, max_depth=int(cfg.max_depth),
                   min_data_in_leaf=int(cfg.min_data_in_leaf),

@@ -78,7 +78,11 @@ def predict_trees(stack: TreeStack, X: jax.Array, *, depth: int) -> jax.Array:
             v = jnp.take_along_axis(Xf, f[:, None], axis=1)[:, 0]
             t = th[safe]
             cat = dc[safe] == 1
-            gl = jnp.where(cat, v == t, v <= t)
+            # categorical: int truncation compare, matching the host walk
+            # (tree.py predict_leaf_index: v.astype(int64) == thr int64)
+            gl = jnp.where(cat,
+                           v.astype(jnp.int32) == t.astype(jnp.int32),
+                           v <= t)
             nxt = jnp.where(gl, lc[safe], rc[safe])
             return jnp.where(node >= 0, nxt, node)
 

@@ -1,0 +1,92 @@
+"""Multi-host bootstrap (lightgbm_tpu/distributed.py).
+
+Reference analog: Network::Init + machine-list parsing
+(application.cpp:185-197, linkers_socket.cpp:73-110).  The real 2-process
+test spawns two worker processes that bring up a global 8-device world via
+`init_distributed` and run a cross-process psum — the "fake cluster" the
+reference never had (SURVEY.md §4).
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.distributed import parse_machine_list, resolve_rank
+
+
+@pytest.mark.quick
+def test_parse_machine_list(tmp_path):
+    f = tmp_path / "mlist.txt"
+    f.write_text("10.0.0.1 12400\n"
+                 "# comment\n"
+                 "10.0.0.2 12400 rank=5\n"
+                 "\n"
+                 "10.0.0.3,12401\n")
+    m = parse_machine_list(str(f))
+    assert m == [("10.0.0.1", 12400, None), ("10.0.0.2", 12400, 5),
+                 ("10.0.0.3", 12401, None)]
+    bad = tmp_path / "bad.txt"
+    bad.write_text("10.0.0.1\n")
+    with pytest.raises(ValueError):
+        parse_machine_list(str(bad))
+
+
+@pytest.mark.quick
+def test_resolve_rank(tmp_path, monkeypatch):
+    machines = [("10.9.9.1", 1, None), ("10.9.9.2", 1, None)]
+    monkeypatch.setenv("LIGHTGBM_TPU_MACHINE_RANK", "1")
+    assert resolve_rank(machines) == 1
+    monkeypatch.delenv("LIGHTGBM_TPU_MACHINE_RANK")
+    # localhost entries resolve by address match
+    assert resolve_rank([("10.9.9.1", 1, None),
+                         ("127.0.0.1", 1, None)]) == 1
+    with pytest.raises(ValueError):
+        resolve_rank(machines)
+
+
+_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, {root!r})
+    from lightgbm_tpu.distributed import init_distributed
+    assert init_distributed(num_machines=2, local_listen_port={port})
+    assert len(jax.devices()) == 8 and len(jax.local_devices()) == 4
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    mesh = Mesh(np.asarray(jax.devices()).reshape(8), ("data",))
+    y = jax.jit(jax.shard_map(lambda x: jax.lax.psum(x, "data"),
+                              mesh=mesh, in_specs=P("data"),
+                              out_specs=P("data")))(jnp.ones(8))
+    local = np.asarray(y.addressable_shards[0].data)
+    assert float(local.reshape(-1)[0]) == 8.0
+    print("RANK_OK", jax.process_index())
+""")
+
+
+def test_two_process_world(tmp_path):
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    port = 12437
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER.format(root=root, port=port))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = []
+    for rank in (0, 1):
+        e = dict(env, LIGHTGBM_TPU_MACHINE_RANK=str(rank))
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=e,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        outs.append(out)
+        assert p.returncode == 0, out[-2000:]
+    assert any("RANK_OK 0" in o for o in outs)
+    assert any("RANK_OK 1" in o for o in outs)

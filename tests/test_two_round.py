@@ -1,0 +1,106 @@
+"""Streaming two-round ingestion (use_two_round_loading).
+
+Reference: DatasetLoader two-round mode (dataset_loader.cpp:159-216) —
+sample pass for bin mappers, then a second streaming pass binning straight
+into the store; the full float64 matrix never materializes.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.dataset import Dataset
+
+
+@pytest.mark.quick
+def test_small_file_exact_match(tmp_path):
+    """When the sample covers every row, two-round must bin identically
+    to the one-shot path."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(5000, 6)
+    y = (X[:, 0] > 0).astype(float)
+    f = str(tmp_path / "small.tsv")
+    np.savetxt(f, np.column_stack([y, X]), delimiter="\t", fmt="%.10g")
+    d1 = Dataset.from_file(f, Config())
+    d2 = Dataset.from_file(f, Config(use_two_round_loading=True))
+    assert np.array_equal(d1.bins, d2.bins)
+    assert np.array_equal(np.asarray(d1.metadata.label),
+                          np.asarray(d2.metadata.label))
+
+
+@pytest.mark.quick
+def test_header_and_label_column(tmp_path):
+    import pandas as pd
+    rng = np.random.RandomState(1)
+    X = rng.randn(2000, 4)
+    y = (X[:, 1] > 0).astype(float)
+    f = str(tmp_path / "h.csv")
+    pd.DataFrame(np.column_stack([X[:, 0], y, X[:, 1:]]),
+                 columns=["a", "target", "b", "c", "d"]).to_csv(
+        f, index=False)
+    d1 = Dataset.from_file(f, Config(has_header=True, label_column="1"))
+    d2 = Dataset.from_file(f, Config(has_header=True, label_column="1",
+                                     use_two_round_loading=True))
+    assert np.array_equal(d1.bins, d2.bins)
+    assert d2.feature_names == ["a", "b", "c", "d"]
+
+
+@pytest.mark.quick
+def test_sampled_reservoir_statistics(tmp_path):
+    """With a sample smaller than the file, the reservoir still produces
+    near-identical bin boundaries (same data distribution)."""
+    rng = np.random.RandomState(2)
+    X = rng.randn(60_000, 4)
+    y = (X[:, 0] > 0).astype(float)
+    f = str(tmp_path / "big.tsv")
+    np.savetxt(f, np.column_stack([y, X]), delimiter="\t", fmt="%.6g")
+    d1 = Dataset.from_file(f, Config(bin_construct_sample_cnt=20_000))
+    d2 = Dataset.from_file(f, Config(bin_construct_sample_cnt=20_000,
+                                     use_two_round_loading=True))
+    assert d1.num_data == d2.num_data
+    # different 20k samples of the same distribution: order-statistic
+    # jitter moves boundaries by ~1 bin width at 255 bins (rank SE
+    # ~sqrt(20000)/255), so exact ids differ freely but rarely by more
+    # than a couple of bins
+    diff = np.abs(d1.bins.astype(np.int32) - d2.bins.astype(np.int32))
+    assert (diff <= 3).mean() > 0.99, (diff <= 3).mean()
+    # the functional check: both datasets train to the same quality
+    from lightgbm_tpu.boosting.gbdt import create_boosting
+    from lightgbm_tpu.metrics import create_metric
+
+    def final_metric(ds):
+        cfg = Config(num_leaves=15, objective="binary", verbose=-1)
+        g = create_boosting(cfg)
+        g.reset_training_data(ds)
+        for _ in range(10):
+            g.train_one_iter()
+        return g.eval_train()[0][2]
+
+    a1, a2 = final_metric(d1), final_metric(d2)
+    assert abs(a1 - a2) < 0.01, (a1, a2)
+
+
+@pytest.mark.quick
+def test_side_files_still_loaded(tmp_path):
+    rng = np.random.RandomState(3)
+    X = rng.randn(1000, 3)
+    y = rng.rand(1000)
+    f = str(tmp_path / "d.tsv")
+    np.savetxt(f, np.column_stack([y, X]), delimiter="\t", fmt="%.8g")
+    w = rng.rand(1000)
+    np.savetxt(f + ".weight", w, fmt="%.8g")
+    ds = Dataset.from_file(f, Config(use_two_round_loading=True))
+    assert np.allclose(ds.metadata.weights, w, atol=1e-6)
+
+
+@pytest.mark.quick
+def test_selectors_rejected(tmp_path):
+    rng = np.random.RandomState(4)
+    X = rng.randn(100, 3)
+    f = str(tmp_path / "d.tsv")
+    np.savetxt(f, np.column_stack([rng.rand(100), X]), delimiter="\t",
+               fmt="%.8g")
+    with pytest.raises(NotImplementedError):
+        Dataset.from_file(f, Config(use_two_round_loading=True,
+                                    weight_column="1"))
