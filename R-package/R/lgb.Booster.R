@@ -1,0 +1,82 @@
+# lgb.Booster — a trained model, backed by the LightGBM-compatible model
+# text file (the same checkpoint format the reference reads/writes,
+# gbdt.cpp:694-848).  Prediction shells out to `task=predict`.
+
+.lgb.python <- function() {
+  Sys.getenv("LIGHTGBM_TPU_PYTHON", "python3")
+}
+
+.lgb.cli <- function(args) {
+  out <- suppressWarnings(system2(
+    .lgb.python(), c("-m", "lightgbm_tpu", args),
+    stdout = TRUE, stderr = TRUE))
+  status <- attr(out, "status")
+  if (!is.null(status) && status != 0) {
+    stop("lightgbm_tpu CLI failed:\n", paste(out, collapse = "\n"))
+  }
+  out
+}
+
+.lgb.new_booster <- function(model_file, evals_log = NULL) {
+  bst <- list(model_file = model_file, evals_log = evals_log)
+  class(bst) <- "lgb.Booster"
+  bst
+}
+
+lgb.load <- function(filename) {
+  if (!file.exists(filename)) stop("no such model file: ", filename)
+  .lgb.new_booster(filename)
+}
+
+lgb.save <- function(booster, filename) {
+  file.copy(booster$model_file, filename, overwrite = TRUE)
+  invisible(filename)
+}
+
+print.lgb.Booster <- function(x, ...) {
+  n_trees <- length(grep("^Tree=", readLines(x$model_file)))
+  cat(sprintf("<lgb.Booster: %d trees, model file %s>\n",
+              n_trees, x$model_file))
+  invisible(x)
+}
+
+predict.lgb.Booster <- function(object, data, raw_score = FALSE,
+                                leaf_index = FALSE, num_iteration = -1,
+                                ...) {
+  dir <- tempdir()
+  if (is.character(data) && length(data) == 1L) {
+    data_file <- data
+  } else {
+    x <- as.matrix(data)
+    data_file <- file.path(dir, paste0(
+      "lgbtpu_pred_", as.integer(stats::runif(1, 1, 1e9)), ".tsv"))
+    # prediction files carry a dummy label column 0 (CLI label_column=0)
+    utils::write.table(cbind(0, x), data_file, sep = "\t",
+                       row.names = FALSE, col.names = FALSE)
+  }
+  out_file <- file.path(dir, paste0(
+    "lgbtpu_out_", as.integer(stats::runif(1, 1, 1e9)), ".txt"))
+  args <- c("task=predict",
+            paste0("data=", data_file),
+            paste0("input_model=", object$model_file),
+            paste0("output_result=", out_file),
+            paste0("num_iteration_predict=", num_iteration))
+  if (raw_score) args <- c(args, "predict_raw_score=true")
+  if (leaf_index) args <- c(args, "predict_leaf_index=true")
+  .lgb.cli(args)
+  res <- utils::read.table(out_file, sep = "\t")
+  if (ncol(res) == 1L) res[[1L]] else as.matrix(res)
+}
+
+lgb.importance <- function(booster) {
+  lines <- readLines(booster$model_file)
+  start <- grep("^feature importances:", lines)
+  if (length(start) == 0L) return(data.frame(Feature = character(),
+                                             Gain = integer()))
+  imp <- lines[(start + 1L):length(lines)]
+  imp <- imp[nzchar(imp)]
+  kv <- strsplit(imp, "=")
+  data.frame(Feature = vapply(kv, `[`, "", 1L),
+             SplitCount = as.integer(vapply(kv, `[`, "", 2L)),
+             stringsAsFactors = FALSE)
+}

@@ -1,0 +1,45 @@
+# lgb.Dataset — data container for lightgbm.tpu.
+#
+# Mirrors the reference R package's lgb.Dataset (R-package/R/lgb.Dataset.R)
+# but holds either a file path (used as-is by the CLI) or an in-memory
+# matrix that is written to a temporary TSV at training time.  Weights,
+# query groups and init scores map onto the CLI's side-file contract
+# (<data>.weight / <data>.query / <data>.init, reference
+# src/io/metadata.cpp:372-437).
+
+lgb.Dataset <- function(data, label = NULL, weight = NULL, group = NULL,
+                        init_score = NULL, params = list()) {
+  ds <- list(data = data, label = label, weight = weight, group = group,
+             init_score = init_score, params = params, file = NULL)
+  class(ds) <- "lgb.Dataset"
+  ds
+}
+
+# Write the dataset to disk in the CLI's TSV + side-file layout and
+# return the data file path.  File-backed datasets pass through.
+.lgb.materialize <- function(ds, dir = tempdir(), tag = "train") {
+  if (is.character(ds$data) && length(ds$data) == 1L) {
+    return(ds$data)
+  }
+  x <- as.matrix(ds$data)
+  if (is.null(ds$label)) {
+    stop("lgb.Dataset with a matrix needs a label")
+  }
+  f <- file.path(dir, paste0("lgbtpu_", tag, "_",
+                             as.integer(stats::runif(1, 1, 1e9)), ".tsv"))
+  utils::write.table(cbind(ds$label, x), f, sep = "\t",
+                     row.names = FALSE, col.names = FALSE)
+  if (!is.null(ds$weight)) {
+    utils::write.table(ds$weight, paste0(f, ".weight"),
+                       row.names = FALSE, col.names = FALSE)
+  }
+  if (!is.null(ds$group)) {
+    utils::write.table(ds$group, paste0(f, ".query"),
+                       row.names = FALSE, col.names = FALSE)
+  }
+  if (!is.null(ds$init_score)) {
+    utils::write.table(ds$init_score, paste0(f, ".init"),
+                       row.names = FALSE, col.names = FALSE)
+  }
+  f
+}

@@ -1,0 +1,73 @@
+# lgb.train / lgb.cv — the training entry points, mirroring the reference
+# R package (R-package/R/lgb.train.R, lgb.cv.R) over the CLI contract:
+# params become a LightGBM config file (key=value lines, the format
+# src/io/config.cpp parses), training runs `task=train`, and the returned
+# booster wraps the output model text.
+
+.lgb.write_conf <- function(params, extra, dir) {
+  conf <- file.path(dir, paste0("lgbtpu_conf_",
+                                as.integer(stats::runif(1, 1, 1e9)),
+                                ".conf"))
+  all <- c(params, extra)
+  lines <- vapply(names(all), function(k) {
+    v <- all[[k]]
+    if (is.logical(v)) v <- tolower(as.character(v))
+    paste0(k, " = ", paste(v, collapse = ","))
+  }, "")
+  writeLines(lines, conf)
+  conf
+}
+
+lgb.train <- function(params = list(), data, nrounds = 100L,
+                      valids = list(), early_stopping_rounds = NULL,
+                      verbose = 1L) {
+  if (!inherits(data, "lgb.Dataset")) {
+    stop("data must be an lgb.Dataset")
+  }
+  dir <- tempdir()
+  train_file <- .lgb.materialize(data, dir, "train")
+  model_file <- file.path(dir, paste0(
+    "lgbtpu_model_", as.integer(stats::runif(1, 1, 1e9)), ".txt"))
+  extra <- list(task = "train", data = train_file,
+                num_trees = as.integer(nrounds),
+                output_model = model_file)
+  if (length(valids)) {
+    vfiles <- vapply(seq_along(valids), function(i) {
+      .lgb.materialize(valids[[i]], dir, paste0("valid", i))
+    }, "")
+    extra$valid <- paste(vfiles, collapse = ",")
+  }
+  if (!is.null(early_stopping_rounds)) {
+    extra$early_stopping_round <- as.integer(early_stopping_rounds)
+  }
+  if (verbose <= 0L) extra$verbose <- -1L
+  conf <- .lgb.write_conf(params, extra, dir)
+  log <- .lgb.cli(paste0("config=", conf))
+  if (!file.exists(model_file)) {
+    stop("training produced no model:\n", paste(log, collapse = "\n"))
+  }
+  .lgb.new_booster(model_file, evals_log = log)
+}
+
+lgb.cv <- function(params = list(), data, nrounds = 100L, nfold = 5L,
+                   verbose = 1L) {
+  if (!inherits(data, "lgb.Dataset")) stop("data must be an lgb.Dataset")
+  x <- as.matrix(data$data)
+  y <- data$label
+  n <- nrow(x)
+  folds <- sample(rep_len(seq_len(nfold), n))
+  scores <- vector("list", nfold)
+  for (k in seq_len(nfold)) {
+    tr <- lgb.Dataset(x[folds != k, , drop = FALSE], y[folds != k],
+                      params = data$params)
+    te <- lgb.Dataset(x[folds == k, , drop = FALSE], y[folds == k],
+                      params = data$params)
+    bst <- lgb.train(params, tr, nrounds,
+                     valids = list(test = te), verbose = verbose)
+    # last reported metric line for the fold's valid set
+    metric_lines <- grep(": *[-0-9.eE]+$", bst$evals_log, value = TRUE)
+    scores[[k]] <- utils::tail(metric_lines, 1L)
+  }
+  structure(list(folds = folds, fold_results = scores),
+            class = "lgb.cv_result")
+}

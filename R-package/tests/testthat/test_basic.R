@@ -1,0 +1,40 @@
+# Behavioral tests mirroring the reference R package's testthat suite
+# (reference R-package/tests/); run with testthat when R is available.
+library(testthat)
+library(lightgbm.tpu)
+
+test_that("train, predict, save/load round-trip", {
+  set.seed(1)
+  n <- 1000
+  x <- matrix(rnorm(n * 5), n, 5)
+  y <- as.numeric(x[, 1] + 0.5 * x[, 2] > 0)
+  dtrain <- lgb.Dataset(x, label = y)
+  bst <- lgb.train(list(objective = "binary", num_leaves = 15,
+                        verbose = -1), dtrain, nrounds = 20)
+  p <- predict(bst, x)
+  expect_equal(length(p), n)
+  expect_true(mean((p > 0.5) == (y > 0.5)) > 0.8)
+
+  f <- tempfile(fileext = ".txt")
+  lgb.save(bst, f)
+  bst2 <- lgb.load(f)
+  expect_equal(predict(bst2, x), p)
+
+  praw <- predict(bst, x, raw_score = TRUE)
+  expect_equal(1 / (1 + exp(-praw)), p, tolerance = 1e-6)
+
+  imp <- lgb.importance(bst)
+  expect_true(nrow(imp) > 0)
+})
+
+test_that("weights and query groups reach training via side files", {
+  set.seed(2)
+  n <- 400
+  x <- matrix(rnorm(n * 3), n, 3)
+  y <- as.numeric(x[, 1] > 0)
+  w <- runif(n) + 0.5
+  dtrain <- lgb.Dataset(x, label = y, weight = w)
+  bst <- lgb.train(list(objective = "binary", num_leaves = 7,
+                        verbose = -1), dtrain, nrounds = 5)
+  expect_s3_class(bst, "lgb.Booster")
+})

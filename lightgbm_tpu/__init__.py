@@ -7,7 +7,21 @@ histograms on the MXU, split scans on the VPU, distributed learners as
 XLA collectives over a device mesh.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
+
+# Honor JAX_PLATFORMS even under TPU plugins that ignore the environment
+# variable (the axon remote-TPU plugin does): a subprocess that asks for
+# CPU must never open a TPU session — a second concurrent session can
+# wedge the tunnel for the first.
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS"):
+    import jax as _jax
+
+    try:
+        _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+    except Exception:  # already initialized with a platform: leave it
+        pass
 
 from .config import Config, config_from_params, PARAM_ALIASES
 from .dataset import Dataset as RawDataset, Metadata

@@ -275,31 +275,15 @@ def load_file_two_round(path: str, cfg: Config,
         used = [i for i, m in enumerate(mappers) if not m.is_trivial]
 
     # ---- pass 2: bin straight into the store ----------------------------
-    ds = Dataset.__new__(Dataset)
-    ds.config = cfg
-    ds.num_data = n
-    ds.num_total_features = sample.shape[1]
-    ds.feature_names = x_names or [f"Column_{i}"
-                                   for i in range(sample.shape[1])]
-    ds.mappers = mappers
-    ds.used_features = used
-    F = len(used)
-    ds.num_bins = np.array([mappers[i].num_bin for i in used], np.int32)
-    ds.max_num_bin = int(ds.num_bins.max()) if F else 1
-    dtype = np.uint8 if ds.max_num_bin <= 256 else np.uint16
-    ds.bins = np.empty((F, n), dtype=dtype)
+    ds = Dataset._empty_from_mappers(cfg, mappers, used, n,
+                                     sample.shape[1], x_names)
     row = 0
     for ch in chunks():
         arr = ch.to_numpy(dtype=np.float64)
         X = np.delete(arr, label_idx, axis=1)
-        for k, i in enumerate(used):
-            ds.bins[k, row:row + len(X)] = mappers[i].value_to_bin(
-                X[:, i]).astype(dtype)
+        ds._bin_rows_into(X, row)
         row += len(X)
-    ds.is_categorical = np.array(
-        [mappers[i].bin_type == CATEGORICAL for i in used], bool)
     ds.metadata = md
-    ds._device_bins = None
     return ds
 
 
@@ -350,27 +334,12 @@ class Dataset:
         self.max_num_bin = int(self.num_bins.max()) if F else 1
         dtype = np.uint8 if self.max_num_bin <= 256 else np.uint16
         self.bins = np.empty((F, n), dtype=dtype)
-        # numerical columns go through the native bulk binner when built
-        # (src/native/loader.cpp lgbt_bin_numerical); the rest via NumPy
-        num_ks = [k for k, i in enumerate(self.used_features)
-                  if self.mappers[i].bin_type == NUMERICAL]
-        done = set()
-        if dtype == np.uint8 and num_ks:
-            from .native import bin_numerical_native
-            cols = [self.used_features[k] for k in num_ks]
-            uppers = [self.mappers[i].bin_upper_bound for i in cols]
-            out = bin_numerical_native(X, cols, uppers)
-            if out is not None:
-                for j, k in enumerate(num_ks):
-                    self.bins[k] = out[j]
-                done = set(num_ks)
-        for k, i in enumerate(self.used_features):
-            if k not in done:
-                self.bins[k] = self.mappers[i].value_to_bin(
-                    X[:, i]).astype(dtype)
         self.is_categorical = np.array(
             [self.mappers[i].bin_type == CATEGORICAL for i in self.used_features],
             dtype=bool)
+        # numerical columns go through the native bulk binner when built
+        # (src/native/loader.cpp lgbt_bin_numerical); the rest via NumPy
+        self._bin_rows_into(X, 0)
 
         md = metadata or Metadata()
         if label is not None:
@@ -383,6 +352,55 @@ class Dataset:
         self._device_bins = None
 
     # -- helpers ------------------------------------------------------------
+
+    @classmethod
+    def _empty_from_mappers(cls, cfg: Config, mappers: List[BinMapper],
+                            used: List[int], n: int, num_total: int,
+                            feature_names: Optional[List[str]]) -> "Dataset":
+        """Allocate a Dataset shell (store + derived per-feature metadata)
+        from existing bin mappers; callers fill `bins` and `metadata`.
+        The single place the mapper→store derivation lives — __init__ and
+        the streaming two-round loader both use it."""
+        ds = cls.__new__(cls)
+        ds.config = cfg
+        ds.num_data = n
+        ds.num_total_features = num_total
+        ds.feature_names = (feature_names
+                            or [f"Column_{i}" for i in range(num_total)])
+        ds.mappers = mappers
+        ds.used_features = used
+        F = len(used)
+        ds.num_bins = np.array([mappers[i].num_bin for i in used],
+                               dtype=np.int32)
+        ds.max_num_bin = int(ds.num_bins.max()) if F else 1
+        dtype = np.uint8 if ds.max_num_bin <= 256 else np.uint16
+        ds.bins = np.empty((F, n), dtype=dtype)
+        ds.is_categorical = np.array(
+            [mappers[i].bin_type == CATEGORICAL for i in used], dtype=bool)
+        ds.metadata = Metadata()
+        ds._device_bins = None
+        return ds
+
+    def _bin_rows_into(self, X: np.ndarray, row0: int) -> None:
+        """Bin raw rows X into self.bins[:, row0:row0+len(X)], using the
+        native bulk binner for uint8 numerical columns when built."""
+        dtype = self.bins.dtype
+        num_ks = [k for k, i in enumerate(self.used_features)
+                  if self.mappers[i].bin_type == NUMERICAL]
+        done = set()
+        if dtype == np.uint8 and num_ks:
+            from .native import bin_numerical_native
+            cols = [self.used_features[k] for k in num_ks]
+            uppers = [self.mappers[i].bin_upper_bound for i in cols]
+            out = bin_numerical_native(np.ascontiguousarray(X), cols, uppers)
+            if out is not None:
+                for j, k in enumerate(num_ks):
+                    self.bins[k, row0:row0 + len(X)] = out[j]
+                done = set(num_ks)
+        for k, i in enumerate(self.used_features):
+            if k not in done:
+                self.bins[k, row0:row0 + len(X)] = self.mappers[
+                    i].value_to_bin(X[:, i]).astype(dtype)
 
     @property
     def num_features(self) -> int:

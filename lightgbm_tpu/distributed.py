@@ -76,9 +76,17 @@ def resolve_rank(machines: List[Tuple[str, int, Optional[int]]]) -> int:
     if env is not None:
         return int(env)
     local = set(_local_addresses())
-    for i, (ip, _port, rank) in enumerate(machines):
-        if ip in local:
-            return rank if rank is not None else i
+    matches = [(i, rank) for i, (ip, _port, rank) in enumerate(machines)
+               if ip in local]
+    if len(matches) > 1:
+        # same host listed more than once (multi-process single host):
+        # the address alone cannot disambiguate the processes
+        raise ValueError(
+            "machine_list has multiple local entries; set "
+            "LIGHTGBM_TPU_MACHINE_RANK per process to disambiguate")
+    if matches:
+        i, rank = matches[0]
+        return rank if rank is not None else i
     raise ValueError(
         "cannot determine this machine's rank: none of the machine_list "
         "addresses are local; set LIGHTGBM_TPU_MACHINE_RANK")

@@ -14,7 +14,7 @@ from lightgbm_tpu.learner.fused import (FusedTreeLearner, make_mesh,
                                         create_tree_learner)
 
 
-def _make_data(n=4000, f=12, seed=7):
+def _make_data(n=1201, f=8, seed=7):
     rng = np.random.RandomState(seed)
     X = rng.randn(n, f)
     y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] + 0.1 * rng.randn(n) > 0
@@ -89,12 +89,12 @@ def test_sharded_bagging_counts(small_problem):
     ds, cfg, grad, hess = small_problem
     import copy
     cfg = copy.deepcopy(cfg)
-    mesh = make_mesh("data", 3)       # N=4000 → Np=4002, 2 padding rows
+    mesh = make_mesh("data", 3)       # N=1201 → Np=1203, 2 padding rows
     learner = FusedTreeLearner(ds, cfg, mesh=mesh)
-    n_bag = 1000
+    n_bag = 500
     rng = np.random.RandomState(0)
     idx = np.sort(rng.choice(ds.num_data, n_bag, replace=False))
-    padded = np.full(1024, ds.num_data, np.int32)
+    padded = np.full(512, ds.num_data, np.int32)
     padded[:n_bag] = idx
     tree, _ = learner.train(jnp.asarray(grad), jnp.asarray(hess),
                             jnp.asarray(padded), n_bag)
@@ -108,7 +108,7 @@ def test_voting_parallel_matches_data_parallel_when_topk_covers():
     top_k >= num_features every feature's histogram is exchanged, so the
     tree must equal plain data-parallel exactly."""
     rng = np.random.RandomState(7)
-    N, F = 3000, 50
+    N, F = 1500, 30
     X = rng.randn(N, F)
     y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] - 0.3 * X[:, 3]
          + 0.1 * rng.randn(N) > 0).astype(np.float64)
@@ -147,6 +147,6 @@ def test_end_to_end_data_parallel(binary_example):
     train = lgb.Dataset(X, y)
     valid = lgb.Dataset(Xt, yt, reference=train)
     evals_result = {}
-    lgb.train(params, train, num_boost_round=20, valid_sets=[valid],
+    lgb.train(params, train, num_boost_round=8, valid_sets=[valid],
               evals_result=evals_result, verbose_eval=False)
-    assert evals_result["valid_0"]["binary_logloss"][-1] < 0.6
+    assert evals_result["valid_0"]["binary_logloss"][-1] < 0.65

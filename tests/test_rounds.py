@@ -14,11 +14,11 @@ from lightgbm_tpu.learner.fused import make_mesh
 @pytest.fixture(scope="module")
 def problem():
     rng = np.random.RandomState(7)
-    X = rng.randn(4000, 12)
-    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] + 0.1 * rng.randn(4000) > 0
+    X = rng.randn(1200, 8)
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] + 0.1 * rng.randn(1200) > 0
          ).astype(np.float64)
     cfg = config_from_params({
-        "objective": "binary", "num_leaves": 63, "min_data_in_leaf": 50,
+        "objective": "binary", "num_leaves": 31, "min_data_in_leaf": 25,
         "verbose": -1, "min_gain_to_split": 0.1})
     ds = RawDataset(X, y, config=cfg)
     p = 0.5
