@@ -18,10 +18,24 @@ northstar_measured.json when present.
 """
 import json
 import os
+import subprocess
 import sys
 import time
 
 import numpy as np
+
+
+def default_backend_alive(timeout_s: int = 150) -> bool:
+    """Probe the default JAX backend in a SUBPROCESS.  The remote-TPU
+    tunnel can wedge such that jax initialization blocks forever; an
+    in-process attempt would hang this benchmark unrecoverably."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
 
 ROWS = int(os.environ.get("BENCH_ROWS", 10_500_000))
 ITERS = int(os.environ.get("BENCH_ITERS", 60))
@@ -42,6 +56,19 @@ def synth_higgs(n, f=28, seed=42):
 
 def main():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    global ROWS, ITERS
+    note = None
+    if not default_backend_alive():
+        # degrade instead of hanging: CPU backend, small workload, and an
+        # explicit note so the record shows WHY this is not a TPU number
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        ROWS = min(ROWS, 200_000)
+        ITERS = min(ITERS, 5)
+        note = ("TPU backend unreachable (remote tunnel did not answer a "
+                "150s probe); CPU fallback at reduced shape - NOT the "
+                "tracked metric")
     import lightgbm_tpu as lgb
 
     X, y = synth_higgs(ROWS)
@@ -90,6 +117,8 @@ def main():
         "unit": "s/iter",
         "vs_baseline": round(vs, 4),
     }
+    if note:
+        out["note"] = note
     # full 500-iteration accuracy evidence (scripts/run_northstar.py)
     ns_file = os.path.join(root, "northstar_measured.json")
     if os.path.exists(ns_file):

@@ -15,6 +15,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from . import log
 from .basic import Booster, Dataset, LightGBMError
 from .boosting.gbdt import create_boosting
 from .config import (Config, check_param_conflict, config_from_params,
@@ -23,8 +24,7 @@ from .dataset import Dataset as RawDataset, parse_text_file
 
 
 def _log(cfg: Config, msg: str) -> None:
-    if cfg.verbose >= 1:
-        print(f"[LightGBM-TPU] [Info] {msg}", flush=True)
+    log.info(msg)
 
 
 def _label_idx(cfg: Config) -> int:

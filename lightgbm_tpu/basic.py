@@ -16,10 +16,7 @@ import numpy as np
 from .config import Config, config_from_params
 from .dataset import Dataset as _InnerDataset, Metadata
 from .boosting.gbdt import GBDT, create_boosting
-
-
-class LightGBMError(Exception):
-    """Error raised by this package (reference basic.py LightGBMError)."""
+from .log import LightGBMError  # noqa: F401  (canonical error type)
 
 
 def _to_numpy(data) -> np.ndarray:

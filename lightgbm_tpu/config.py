@@ -343,6 +343,10 @@ def config_from_params(params: Dict[str, Any], **overrides) -> Config:
             kwargs[k] = _coerce(k, v)
     cfg = Config(**kwargs)
     check_param_conflict(cfg)
+    # the package-wide log level follows the most recently parsed config
+    # (reference: Log verbosity set once from config, log.h:38)
+    from . import log
+    log.configure(cfg.verbose)
     return cfg
 
 

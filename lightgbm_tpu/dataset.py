@@ -604,8 +604,8 @@ class Dataset:
         if wi is not None:
             xw = _xcol(wi, "weight_column")
             if md.weights is not None:
-                import warnings
-                warnings.warn("weight_column overrides the .weight side file")
+                from . import log
+                log.warning("weight_column overrides the .weight side file")
             md.weights = X[:, xw].astype(np.float32)
             drop.append(xw)
         gi = _resolve(cfg.group_column, "group_column")
@@ -621,8 +621,8 @@ class Dataset:
                     "group_column: rows of the same query must be "
                     "contiguous in the data file")
             if md.query_boundaries is not None:
-                import warnings
-                warnings.warn("group_column overrides the .query side file")
+                from . import log
+                log.warning("group_column overrides the .query side file")
             md.query_boundaries = np.concatenate(
                 [starts, [len(qid)]]).astype(np.int32)
             drop.append(xg)
@@ -652,6 +652,48 @@ class Dataset:
 
         cats = _parse_categorical_column(cfg.categorical_column, x_names,
                                          X.shape[1])
+
+        # distributed pre-partition (reference dataset_loader.cpp:554-659
+        # + distributed bin finding :733-833): in a multi-process world
+        # each process keeps only its row block, with bin mappers derived
+        # from a process-allgathered global sample so every rank bins
+        # identically
+        if cfg.is_pre_partition:
+            import jax
+            if jax.process_count() > 1:
+                from .distributed import (find_bin_mappers_distributed,
+                                          local_row_slice)
+                sl = local_row_slice(len(y))
+                n_local = sl.stop - sl.start
+                if reference is not None:
+                    # valid sets bin with the TRAINING mappers, exactly
+                    # like the non-partitioned paths (Dataset::CheckAlign)
+                    mappers = reference.mappers
+                else:
+                    rng = np.random.RandomState(cfg.data_random_seed)
+                    take = min(cfg.bin_construct_sample_cnt
+                               // jax.process_count() + 1, max(n_local, 1))
+                    samp = (np.sort(rng.choice(n_local, take,
+                                               replace=False))
+                            if n_local > 0 else np.zeros(0, np.int64))
+                    mappers = find_bin_mappers_distributed(
+                        X[sl][samp], cfg, categorical=cats)
+                used = [i for i, m in enumerate(mappers) if not m.is_trivial]
+                ds = Dataset._empty_from_mappers(
+                    cfg, mappers, used, n_local, X.shape[1], x_names)
+                ds._bin_rows_into(X[sl], 0)
+                ds.metadata = Metadata(
+                    label=np.asarray(y[sl], np.float32),
+                    weights=(None if md.weights is None
+                             else md.weights[sl]),
+                    init_score=(None if md.init_score is None
+                                else md.init_score[sl]))
+                if md.query_boundaries is not None:
+                    raise NotImplementedError(
+                        "pre_partition with query data is not supported "
+                        "yet (queries would straddle row blocks)")
+                return ds
+
         ds = Dataset(X, y, cfg, reference=reference, metadata=md,
                      feature_names=x_names, categorical_feature=cats)
         return ds

@@ -145,3 +145,63 @@ def maybe_init_from_config(cfg) -> bool:
     return init_distributed(int(getattr(cfg, "num_machines", 0) or 0),
                             getattr(cfg, "machine_list_file", ""),
                             int(getattr(cfg, "local_listen_port", 12400)))
+
+
+# ---------------------------------------------------------------------------
+# Distributed ingestion: pre-partitioned rows + global bin mappers
+# (reference dataset_loader.cpp:554-659 row assignment and :733-833
+# distributed bin finding)
+# ---------------------------------------------------------------------------
+
+def local_row_slice(n: int) -> slice:
+    """This process's contiguous row block of an n-row dataset —
+    the TPU-era analog of the reference's pre-partition row assignment
+    (contiguous blocks instead of mod-assignment so binned stores stay
+    gather-free)."""
+    import jax
+    world = jax.process_count()
+    rank = jax.process_index()
+    per = (n + world - 1) // world
+    return slice(min(rank * per, n), min((rank + 1) * per, n))
+
+
+def find_bin_mappers_distributed(local_sample, cfg, categorical=()):
+    """Global BinMappers from per-process local samples.
+
+    The reference shards FEATURES across machines, finds local mappers,
+    and allgathers the serialized results (dataset_loader.cpp:733-833).
+    Here the sample rows are allgathered instead (one collective on a
+    [S, F] float array) and every process derives identical mappers from
+    the identical global sample — no mapper serialization format needed,
+    determinism by construction."""
+    import jax
+    import numpy as np
+    from .binning import find_bin_mappers
+
+    if jax.process_count() == 1:
+        return find_bin_mappers(
+            local_sample, cfg.max_bin, cfg.min_data_in_bin,
+            cfg.min_data_in_leaf, categorical=categorical,
+            sample_cnt=len(local_sample), seed=cfg.data_random_seed)
+    from jax.experimental import multihost_utils
+
+    # pad local samples to one shape (process sample sizes can differ by
+    # one chunk); true per-process sizes travel alongside so padding rows
+    # are sliced away exactly (no sentinel values — data may contain any)
+    sizes = multihost_utils.process_allgather(
+        np.array([len(local_sample)], np.int64)).reshape(-1)
+    smax = int(sizes.max())
+    padded = np.zeros((smax, local_sample.shape[1]), np.float64)
+    padded[: len(local_sample)] = local_sample
+    gathered = multihost_utils.process_allgather(padded)  # [W, smax, F]
+    flat = np.concatenate([gathered[w, : int(sizes[w])]
+                           for w in range(gathered.shape[0])])
+    cap = int(cfg.bin_construct_sample_cnt)
+    if len(flat) > cap:
+        idx = np.random.RandomState(cfg.data_random_seed).choice(
+            len(flat), cap, replace=False)
+        flat = flat[np.sort(idx)]
+    return find_bin_mappers(
+        flat, cfg.max_bin, cfg.min_data_in_bin, cfg.min_data_in_leaf,
+        categorical=categorical, sample_cnt=len(flat),
+        seed=cfg.data_random_seed)

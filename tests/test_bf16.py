@@ -49,6 +49,7 @@ def test_bf16_histogram_close_to_f32(hist_inputs):
     assert err.mean() < 1e-3, f"mean rel err {err.mean():.2e}"
 
 
+@pytest.mark.slow
 def test_bf16_end_to_end_auc_parity():
     """Full training with histogram_dtype=bfloat16 lands within 0.002 AUC
     of the f32 run at 60k rows (the bench default's justification; the

@@ -70,6 +70,70 @@ _WORKER = textwrap.dedent("""
 """)
 
 
+_LOADER_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, {root!r})
+    import numpy as np
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.dataset import Dataset
+    from lightgbm_tpu.distributed import init_distributed
+    assert init_distributed(num_machines=2, local_listen_port={port})
+
+    cfg = Config(is_pre_partition=True)
+    ds = Dataset.from_file({data!r}, cfg)
+    full = Dataset.from_file({data!r}, Config())
+    w, r = jax.process_count(), jax.process_index()
+    per = (full.num_data + w - 1) // w
+    lo, hi = r * per, min((r + 1) * per, full.num_data)
+    assert ds.num_data == hi - lo, (ds.num_data, lo, hi)
+    # identical mappers on every rank -> local bins equal the matching
+    # block of a full single-process load
+    infos = "|".join(ds.feature_infos())
+    from jax.experimental import multihost_utils
+    h = np.frombuffer(infos.encode()[:64].ljust(64), np.uint8).copy()
+    all_h = multihost_utils.process_allgather(h)
+    assert (all_h[0] == all_h[1]).all(), "mappers differ across ranks"
+    assert np.array_equal(ds.bins, full.bins[:, lo:hi])
+    assert np.array_equal(np.asarray(ds.metadata.label),
+                          np.asarray(full.metadata.label)[lo:hi])
+    print("LOADER_OK", r)
+""")
+
+
+def test_two_process_prepartition_loader(tmp_path):
+    """Each rank loads its pre-partitioned block with bin mappers from a
+    process-allgathered sample: mappers agree, blocks tile the dataset
+    (reference dataset_loader.cpp:554-659, :733-833)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rng = np.random.RandomState(5)
+    X = rng.randn(3001, 6)
+    y = (X[:, 0] > 0).astype(float)
+    data = str(tmp_path / "dist.tsv")
+    np.savetxt(data, np.column_stack([y, X]), delimiter="\t", fmt="%.8g")
+    script = tmp_path / "loader_worker.py"
+    script.write_text(_LOADER_WORKER.format(root=root, port=12439,
+                                            data=data))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = []
+    for rank in (0, 1):
+        e = dict(env, LIGHTGBM_TPU_MACHINE_RANK=str(rank))
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=e,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        outs.append(out)
+        assert p.returncode == 0, out[-2000:]
+    assert any("LOADER_OK 0" in o for o in outs)
+    assert any("LOADER_OK 1" in o for o in outs)
+
+
 def test_two_process_world(tmp_path):
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     port = 12437

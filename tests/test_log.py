@@ -1,0 +1,47 @@
+"""Leveled logger + CHECK framework (lightgbm_tpu/log.py; reference
+include/LightGBM/utils/log.h)."""
+import pytest
+
+pytestmark = pytest.mark.quick
+
+from lightgbm_tpu import log
+from lightgbm_tpu.log import LightGBMError
+
+
+def test_levels(capsys):
+    log.configure(log.INFO)
+    log.info("i1")
+    log.debug("d1")          # suppressed at INFO
+    log.warning("w1")
+    out = capsys.readouterr()
+    assert "i1" in out.out and "d1" not in out.out
+    assert "w1" in out.err
+    log.configure(log.DEBUG)
+    assert log.level() == log.DEBUG
+    log.debug("d2")
+    assert "d2" in capsys.readouterr().out
+    log.configure(-1)
+    log.info("i2")
+    log.warning("w2")
+    out = capsys.readouterr()
+    assert "i2" not in out.out and "w2" not in out.err
+    log.configure(log.INFO)
+
+
+def test_fatal_and_checks():
+    with pytest.raises(LightGBMError):
+        log.fatal("boom")
+    log.check(True)
+    with pytest.raises(LightGBMError, match="Check failed: bad"):
+        log.check(False, "bad")
+    assert log.check_notnull(5, "x") == 5
+    with pytest.raises(LightGBMError, match="x must not be None"):
+        log.check_notnull(None, "x")
+
+
+def test_config_parse_sets_level():
+    from lightgbm_tpu.config import config_from_params
+    config_from_params({"verbose": 2})
+    assert log.level() == 2
+    config_from_params({"verbose": 1})
+    assert log.level() == 1

@@ -139,6 +139,7 @@ def test_voting_parallel_matches_data_parallel_when_topk_covers():
     assert shared >= (t_data.num_leaves - 1) // 2
 
 
+@pytest.mark.slow
 def test_end_to_end_data_parallel(binary_example):
     X, y, Xt, yt = binary_example
     params = {"objective": "binary", "metric": "binary_logloss",

@@ -38,6 +38,7 @@ def test_classifier_multiclass(multiclass_example):
     assert np.mean(clf.predict(Xt) == yt) > 0.3
 
 
+@pytest.mark.slow
 def test_ranker(rank_example):
     X, y, q, Xt, yt, qt = rank_example
     rk = LGBMRanker(n_estimators=20, min_child_samples=20)
