@@ -37,6 +37,15 @@ def default_backend_alive(timeout_s: int = 150) -> bool:
     except subprocess.TimeoutExpired:
         return False
 
+
+def force_cpu_backend() -> None:
+    """Degrade to the CPU backend (must run before jax initializes); the
+    config update is required because remote-TPU plugins can ignore the
+    environment variable."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
 ROWS = int(os.environ.get("BENCH_ROWS", 10_500_000))
 ITERS = int(os.environ.get("BENCH_ITERS", 60))
 WARMUP = int(os.environ.get("BENCH_WARMUP", 3))
@@ -61,9 +70,7 @@ def main():
     if not default_backend_alive():
         # degrade instead of hanging: CPU backend, small workload, and an
         # explicit note so the record shows WHY this is not a TPU number
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        import jax
-        jax.config.update("jax_platforms", "cpu")
+        force_cpu_backend()
         ROWS = min(ROWS, 200_000)
         ITERS = min(ITERS, 5)
         note = ("TPU backend unreachable (remote tunnel did not answer a "

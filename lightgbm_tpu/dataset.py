@@ -663,9 +663,16 @@ class Dataset:
             if jax.process_count() > 1:
                 from .distributed import (find_bin_mappers_distributed,
                                           local_row_slice)
+                if md.query_boundaries is not None:
+                    raise NotImplementedError(
+                        "pre_partition with query data is not supported "
+                        "yet (queries would straddle row blocks)")
                 sl = local_row_slice(len(y))
                 n_local = sl.stop - sl.start
                 if reference is not None:
+                    if X.shape[1] != reference.num_total_features:
+                        raise ValueError(
+                            "validation data has different #features")
                     # valid sets bin with the TRAINING mappers, exactly
                     # like the non-partitioned paths (Dataset::CheckAlign)
                     mappers = reference.mappers
@@ -682,16 +689,21 @@ class Dataset:
                 ds = Dataset._empty_from_mappers(
                     cfg, mappers, used, n_local, X.shape[1], x_names)
                 ds._bin_rows_into(X[sl], 0)
+                init_local = None
+                if md.init_score is not None:
+                    # init_score may be flattened [N * K] class-major
+                    # (score_updater.py consumption): slice per class
+                    n_all = len(y)
+                    if md.init_score.size % n_all:
+                        raise ValueError("init score size mismatch")
+                    k = md.init_score.size // n_all
+                    init_local = md.init_score.reshape(
+                        k, n_all)[:, sl].reshape(-1)
                 ds.metadata = Metadata(
                     label=np.asarray(y[sl], np.float32),
                     weights=(None if md.weights is None
                              else md.weights[sl]),
-                    init_score=(None if md.init_score is None
-                                else md.init_score[sl]))
-                if md.query_boundaries is not None:
-                    raise NotImplementedError(
-                        "pre_partition with query data is not supported "
-                        "yet (queries would straddle row blocks)")
+                    init_score=init_local)
                 return ds
 
         ds = Dataset(X, y, cfg, reference=reference, metadata=md,

@@ -310,36 +310,44 @@ class NDCGMetric(Metric):
         return [(f"ndcg@{k}", float(vals[i])) for i, k in enumerate(ks)]
 
     def eval(self, score, objective=None):
+        """Vectorized host NDCG: ONE lexicographic sort of all rows keyed
+        (query, -score) + segment sums — the same formulation as the
+        device kernel (ops/eval.ndcg_at_k); the reference's per-query
+        loop (rank_metric.hpp) does not scale to MS-LTR's ~31k queries
+        per eval round."""
         qb = self.metadata.query_boundaries
         if qb is None:
             raise ValueError("NDCG metric requires query information")
         ks = list(self.config.ndcg_eval_at)
         s = score.reshape(-1)
         lab = self.label.astype(np.int64)
+        n = len(s)
         Q = len(qb) - 1
-        maxlen = int(np.max(np.diff(qb)))
+        sizes = np.diff(qb)
+        qid = np.repeat(np.arange(Q), sizes)
+        qstart = np.repeat(qb[:-1], sizes)
+        maxlen = int(sizes.max()) if Q else 1
         label_gain, discount = _dcg_tables(self.config, maxlen)
-        # per-query weights (reference: query weights or 1)
-        sums = np.zeros(len(ks))
-        wsum = 0.0
-        for q in range(Q):
-            lo, hi = qb[q], qb[q + 1]
-            lq, sq = lab[lo:hi], s[lo:hi]
-            n = hi - lo
-            order = np.argsort(-sq, kind="stable")
-            gains_sorted = label_gain[lq[order]]
-            ideal = label_gain[np.sort(lq)[::-1]]
-            w = 1.0
-            wsum += w
-            for i, k in enumerate(ks):
-                kk = min(k, n)
-                maxdcg = float((ideal[:kk] * discount[:kk]).sum())
-                if maxdcg <= 0:
-                    sums[i] += w  # reference: all-zero-gain query counts as 1
-                else:
-                    dcg = float((gains_sorted[:kk] * discount[:kk]).sum())
-                    sums[i] += w * dcg / maxdcg
-        return [(f"ndcg@{k}", float(sums[i] / wsum)) for i, k in enumerate(ks)]
+        gains = label_gain[lab]
+        order = np.lexsort((np.arange(n), -s, qid))
+        rank = np.arange(n) - qstart[order]
+        g_sorted = gains[order]
+        qid_sorted = qid[order]
+        iorder = np.lexsort((np.arange(n), -gains, qid))
+        ig_sorted = gains[iorder]
+        disc = discount[np.minimum(rank, maxlen - 1)]
+        out = []
+        for k in ks:
+            within = rank < k
+            dcg = np.bincount(qid_sorted, weights=np.where(
+                within, g_sorted * disc, 0.0), minlength=Q)
+            maxdcg = np.bincount(qid_sorted, weights=np.where(
+                within, ig_sorted * disc, 0.0), minlength=Q)
+            # all-zero-gain queries count as 1 (rank_metric.hpp convention)
+            nd = np.where(maxdcg > 0,
+                          dcg / np.maximum(maxdcg, 1e-300), 1.0)
+            out.append((f"ndcg@{k}", float(nd.mean())))
+        return out
 
 
 class MAPMetric(NDCGMetric):
@@ -361,30 +369,40 @@ class MAPMetric(NDCGMetric):
         return [(f"map@{k}", float(vals[i])) for i, k in enumerate(ks)]
 
     def eval(self, score, objective=None):
+        """Vectorized host MAP (mirrors ops/eval.map_at_k; see NDCGMetric
+        for why the per-query loop is gone)."""
         qb = self.metadata.query_boundaries
         if qb is None:
             raise ValueError("MAP metric requires query information")
         ks = list(self.config.ndcg_eval_at)
         s = score.reshape(-1)
-        lab = self.label > 0
+        rel_all = (self.label > 0).astype(np.float64)
+        n = len(s)
         Q = len(qb) - 1
-        sums = np.zeros(len(ks))
-        wsum = 0.0
-        for q in range(Q):
-            lo, hi = qb[q], qb[q + 1]
-            lq, sq = lab[lo:hi], s[lo:hi]
-            order = np.argsort(-sq, kind="stable")
-            rel = lq[order].astype(np.float64)
-            hits = np.cumsum(rel)
-            prec = hits / (1.0 + np.arange(len(rel)))
-            w = 1.0
-            wsum += w
-            for i, k in enumerate(ks):
-                kk = min(k, len(rel))
-                nrel = rel[:kk].sum()
-                if nrel > 0:
-                    sums[i] += w * float((prec[:kk] * rel[:kk]).sum() / nrel)
-        return [(f"map@{k}", float(sums[i] / wsum)) for i, k in enumerate(ks)]
+        sizes = np.diff(qb)
+        qid = np.repeat(np.arange(Q), sizes)
+        qstart = np.repeat(qb[:-1], sizes)
+        order = np.lexsort((np.arange(n), -s, qid))
+        rank = np.arange(n) - qstart[order]
+        rel = rel_all[order]
+        qid_sorted = qid[order]
+        # within-query hit counts via global cumsum minus query offsets
+        # (the offset of a query is the cumsum at its rank-0 row)
+        csum = np.cumsum(rel) - rel
+        first = np.zeros(Q)
+        first[qid_sorted[rank == 0]] = csum[rank == 0]
+        hits = csum - first[qid_sorted] + rel
+        prec = hits / (1.0 + rank)
+        out = []
+        for k in ks:
+            within = rank < k
+            ap_num = np.bincount(qid_sorted, weights=np.where(
+                within, prec * rel, 0.0), minlength=Q)
+            nrel = np.bincount(qid_sorted, weights=np.where(
+                within, rel, 0.0), minlength=Q)
+            ap = np.where(nrel > 0, ap_num / np.maximum(nrel, 1.0), 0.0)
+            out.append((f"map@{k}", float(ap.sum() / Q)))
+        return out
 
 
 _METRICS = {
