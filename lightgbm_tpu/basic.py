@@ -29,6 +29,50 @@ def _to_numpy(data) -> np.ndarray:
     return np.asarray(data, dtype=np.float64)
 
 
+def _load_pandas_categorical(model_tail: str):
+    """Read the `pandas_categorical:<json>` trailer the save path appends
+    (the reference stores the same trailer, basic.py save_model).
+    `model_tail` may be just the end of the model text."""
+    import json
+    marker = "pandas_categorical:"
+    pos = model_tail.rfind("\n" + marker)
+    if pos < 0:
+        if not model_tail.startswith(marker):
+            return None
+        pos = -1
+    line = model_tail[pos + 1:].splitlines()[0]
+    try:
+        return json.loads(line[len(marker):])
+    except json.JSONDecodeError:
+        from . import log
+        log.warning("model file has a corrupt pandas_categorical trailer; "
+                    "categorical DataFrame prediction will be unavailable")
+        return None
+
+
+def _apply_pandas_categorical(data, pandas_categorical):
+    """Map a prediction DataFrame's category columns to the TRAINING
+    category codes (reference basic.py predict-time pandas handling):
+    category order may differ between frames, so codes are re-derived
+    from the stored training category lists; unseen categories map to -1
+    like pandas' own missing-code convention."""
+    if not (hasattr(data, "dtypes") and hasattr(data, "columns")):
+        return data
+    cat_cols = [c for c in data.columns
+                if str(data[c].dtype) == "category"]
+    if not cat_cols:
+        return data
+    if not pandas_categorical or len(cat_cols) != len(pandas_categorical):
+        raise ValueError(
+            "prediction data has pandas categorical columns but the "
+            "model carries no matching training category lists")
+    df = data.copy()
+    for col, cats in zip(cat_cols, pandas_categorical):
+        df[col] = df[col].cat.set_categories(cats).cat.codes.astype(
+            np.float64)
+    return df
+
+
 def _resolve_categorical(data, categorical_feature, feature_name):
     """pandas categorical columns -> codes + column index list
     (reference basic.py:192-260 pandas handling)."""
@@ -221,6 +265,7 @@ class Booster:
         self.best_score: Dict = {}
         self._valid_names: List[str] = []
         self._valid_data: List["Dataset"] = []
+        self.pandas_categorical = None
         if train_set is not None:
             if not isinstance(train_set, Dataset):
                 raise TypeError("train_set should be Dataset instance")
@@ -229,15 +274,23 @@ class Booster:
             self._gbdt = create_boosting(cfg)
             self._gbdt.reset_training_data(train_set._inner)
             self.train_set = train_set
+            self.pandas_categorical = train_set.pandas_categorical
         elif model_file is not None:
             cfg = config_from_params(params)
             self._gbdt = create_boosting(cfg, model_file)  # loads the model
             self.train_set = None
+            # the trailer is one line at the very end: read only the tail
+            with open(model_file, "rb") as f:
+                f.seek(0, 2)
+                f.seek(max(0, f.tell() - (1 << 20)))
+                tail = f.read().decode(errors="replace")
+            self.pandas_categorical = _load_pandas_categorical(tail)
         elif model_str is not None:
             cfg = config_from_params(params)
             self._gbdt = GBDT(cfg)
             self._gbdt.load_model_from_string(model_str)
             self.train_set = None
+            self.pandas_categorical = _load_pandas_categorical(model_str)
         else:
             raise TypeError("need at least one of train_set, model_file, model_str")
 
@@ -341,6 +394,7 @@ class Booster:
             from .dataset import parse_text_file
             X, _, _ = parse_text_file(data, data_has_header)
         else:
+            data = _apply_pandas_categorical(data, self.pandas_categorical)
             X = _to_numpy(data)
             if X.ndim == 1:
                 X = X.reshape(1, -1)
@@ -352,12 +406,25 @@ class Booster:
 
     # -- model io -----------------------------------------------------------
 
+    def _pandas_categorical_trailer(self) -> str:
+        import json
+        if not self.pandas_categorical:
+            return ""
+        # default=str: categories may be non-JSON types (Timestamp, ...)
+        return ("pandas_categorical:"
+                + json.dumps(self.pandas_categorical, default=str) + "\n")
+
     def save_model(self, filename: str, num_iteration: int = -1) -> "Booster":
         self._gbdt.save_model_to_file(filename, num_iteration)
+        trailer = self._pandas_categorical_trailer()
+        if trailer:
+            with open(filename, "a") as f:
+                f.write(trailer)
         return self
 
     def model_to_string(self, num_iteration: int = -1) -> str:
-        return self._gbdt.save_model_to_string(num_iteration)
+        return (self._gbdt.save_model_to_string(num_iteration)
+                + self._pandas_categorical_trailer())
 
     def dump_model(self, num_iteration: int = -1) -> Dict:
         return self._gbdt.to_json()

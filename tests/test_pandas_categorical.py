@@ -1,0 +1,71 @@
+"""pandas categorical handling (reference test_engine.py:192-236): train
+on a DataFrame with category columns, predict with a frame whose category
+ORDER differs, round-trip the category lists through the model file."""
+import numpy as np
+import pandas as pd
+import pytest
+
+import lightgbm_tpu as lgb
+
+pytestmark = pytest.mark.quick
+
+
+@pytest.fixture(scope="module")
+def cat_frame():
+    rng = np.random.RandomState(0)
+    n = 3000
+    color = rng.choice(["red", "green", "blue", "teal"], n)
+    x1 = rng.randn(n)
+    x2 = rng.randn(n)
+    y = ((color == "green") | (x1 > 0.7)).astype(float)
+    df = pd.DataFrame({"color": pd.Categorical(color), "x1": x1, "x2": x2})
+    return df, y
+
+
+def test_train_predict_category_order_invariance(cat_frame):
+    df, y = cat_frame
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbose": -1, "min_data_in_leaf": 10},
+                    lgb.Dataset(df, y), num_boost_round=20)
+    p1 = bst.predict(df)
+    acc = ((p1 > 0.5) == (y > 0.5)).mean()
+    assert acc > 0.9, acc
+    # same rows, SHUFFLED category order: predictions must not change
+    df2 = df.copy()
+    df2["color"] = df2["color"].cat.reorder_categories(
+        ["teal", "blue", "red", "green"])
+    p2 = bst.predict(df2)
+    np.testing.assert_allclose(p1, p2, atol=1e-12)
+
+
+def test_model_file_roundtrip_with_categories(cat_frame, tmp_path):
+    df, y = cat_frame
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbose": -1, "min_data_in_leaf": 10},
+                    lgb.Dataset(df, y), num_boost_round=10)
+    f = str(tmp_path / "m.txt")
+    bst.save_model(f)
+    assert "pandas_categorical:" in open(f).read()
+    bst2 = lgb.Booster(model_file=f)
+    np.testing.assert_allclose(bst.predict(df), bst2.predict(df),
+                               atol=1e-12)
+    # unseen category at predict time maps to code -1 (no crash)
+    df3 = df.copy()
+    df3["color"] = pd.Categorical(
+        ["purple"] * len(df), categories=["purple"])
+    p = bst2.predict(df3)
+    assert np.isfinite(p).all()
+
+
+def test_numpy_training_has_no_trailer(cat_frame, tmp_path):
+    _, y = cat_frame
+    rng = np.random.RandomState(1)
+    X = rng.randn(len(y), 3)
+    bst = lgb.train({"objective": "binary", "verbose": -1},
+                    lgb.Dataset(X, y), num_boost_round=3)
+    f = str(tmp_path / "m2.txt")
+    bst.save_model(f)
+    assert "pandas_categorical:" not in open(f).read()
+    # and model text still parses
+    bst2 = lgb.Booster(model_file=f)
+    assert bst2.pandas_categorical is None
