@@ -29,6 +29,27 @@ def _to_numpy(data) -> np.ndarray:
     return np.asarray(data, dtype=np.float64)
 
 
+def _read_last_line(path: str) -> str:
+    """The final line of a file, scanning backwards in 1 MB chunks — the
+    pandas_categorical trailer is exactly one line and can be arbitrarily
+    large (high-cardinality categories), so no fixed tail cap is safe."""
+    with open(path, "rb") as f:
+        f.seek(0, 2)
+        end = f.tell()
+        buf = b""
+        pos = end
+        while pos > 0:
+            step = min(1 << 20, pos)
+            pos -= step
+            f.seek(pos)
+            buf = f.read(step) + buf
+            stripped = buf.rstrip(b"\n")
+            nl = stripped.rfind(b"\n")
+            if nl >= 0:
+                return stripped[nl + 1:].decode(errors="replace")
+        return buf.rstrip(b"\n").decode(errors="replace")
+
+
 def _load_pandas_categorical(model_tail: str):
     """Read the `pandas_categorical:<json>` trailer the save path appends
     (the reference stores the same trailer, basic.py save_model).
@@ -279,12 +300,8 @@ class Booster:
             cfg = config_from_params(params)
             self._gbdt = create_boosting(cfg, model_file)  # loads the model
             self.train_set = None
-            # the trailer is one line at the very end: read only the tail
-            with open(model_file, "rb") as f:
-                f.seek(0, 2)
-                f.seek(max(0, f.tell() - (1 << 20)))
-                tail = f.read().decode(errors="replace")
-            self.pandas_categorical = _load_pandas_categorical(tail)
+            self.pandas_categorical = _load_pandas_categorical(
+                _read_last_line(model_file))
         elif model_str is not None:
             cfg = config_from_params(params)
             self._gbdt = GBDT(cfg)
@@ -410,9 +427,17 @@ class Booster:
         import json
         if not self.pandas_categorical:
             return ""
-        # default=str: categories may be non-JSON types (Timestamp, ...)
+        def _reject(o):
+            # stringifying (e.g. Timestamps) would silently break the
+            # save/load round trip: the reloaded strings no longer match
+            # the frame's category values.  Refuse loudly instead (the
+            # reference raises on unserializable categories too).
+            raise LightGBMError(
+                "categorical column categories must be JSON-native "
+                f"(str/int/float/bool) to save the model; got {type(o)}")
         return ("pandas_categorical:"
-                + json.dumps(self.pandas_categorical, default=str) + "\n")
+                + json.dumps(self.pandas_categorical, default=_reject)
+                + "\n")
 
     def save_model(self, filename: str, num_iteration: int = -1) -> "Booster":
         self._gbdt.save_model_to_file(filename, num_iteration)
@@ -461,3 +486,6 @@ class Booster:
         self.train_set = None
         self._valid_names = []
         self._valid_data = []
+        # category lists travel inside the model text trailer
+        self.pandas_categorical = _load_pandas_categorical(
+            state["model_str"])

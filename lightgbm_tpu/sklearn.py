@@ -51,7 +51,21 @@ def _eval_function_wrapper(func: Callable) -> Callable:
     return inner
 
 
-class LGBMModel:
+try:  # sklearn interop (clone / GridSearchCV need BaseEstimator tags)
+    from sklearn.base import (BaseEstimator as _SkBase,
+                              ClassifierMixin as _SkClassifierMixin,
+                              RegressorMixin as _SkRegressorMixin)
+except ImportError:  # sklearn not installed: plain-Python wrappers
+    _SkBase = object
+
+    class _SkClassifierMixin:
+        pass
+
+    class _SkRegressorMixin:
+        pass
+
+
+class LGBMModel(_SkBase):
     def __init__(self, boosting_type: str = "gbdt", num_leaves: int = 31,
                  max_depth: int = -1, learning_rate: float = 0.1,
                  n_estimators: int = 10, max_bin: int = 255,
@@ -68,7 +82,7 @@ class LGBMModel:
                  max_position: int = 20, label_gain=None,
                  drop_rate: float = 0.1, skip_drop: float = 0.5,
                  max_drop: int = 50, uniform_drop: bool = False,
-                 xgboost_dart_mode: bool = False):
+                 xgboost_dart_mode: bool = False, **kwargs):
         self.boosting_type = boosting_type
         self.objective = objective
         self.num_leaves = num_leaves
@@ -102,6 +116,13 @@ class LGBMModel:
         self.max_drop = max_drop
         self.uniform_drop = uniform_drop
         self.xgboost_dart_mode = xgboost_dart_mode
+        # arbitrary LightGBM params pass through (silent in the v2.0-era
+        # fixed signature, a **kwargs superset like later LightGBM): they
+        # participate in get_params/set_params so sklearn clone and
+        # GridSearchCV see them
+        self._other_param_names = sorted(kwargs)
+        for k, v in kwargs.items():
+            setattr(self, k, v)
         self._Booster: Optional[Booster] = None
         self.evals_result: Dict = {}
         self.best_iteration: int = -1
@@ -111,11 +132,19 @@ class LGBMModel:
     def get_params(self, deep: bool = True) -> Dict[str, Any]:
         import inspect
         sig = inspect.signature(LGBMModel.__init__)
-        return {k: getattr(self, k) for k in sig.parameters if k != "self"}
+        out = {k: getattr(self, k) for k in sig.parameters
+               if k not in ("self", "kwargs")}
+        for k in getattr(self, "_other_param_names", ()):
+            out[k] = getattr(self, k)
+        return out
 
     def set_params(self, **params) -> "LGBMModel":
+        import inspect
+        known = set(inspect.signature(LGBMModel.__init__).parameters)
         for k, v in params.items():
             setattr(self, k, v)
+            if k not in known and k not in self._other_param_names:
+                self._other_param_names.append(k)
         return self
 
     def _lgbm_params(self) -> Dict[str, Any]:
@@ -147,6 +176,8 @@ class LGBMModel:
             "max_position": self.max_position,
             "verbose": 0,
         }
+        for k in getattr(self, "_other_param_names", ()):
+            p[k] = getattr(self, k)
         if self.label_gain is not None:
             p["label_gain"] = self.label_gain
         if self.boosting_type == "dart":
@@ -227,12 +258,12 @@ class LGBMModel:
         return self.evals_result
 
 
-class LGBMRegressor(LGBMModel):
+class LGBMRegressor(_SkRegressorMixin, LGBMModel):
     def __init__(self, objective: str = "regression", **kwargs):
         super().__init__(objective=objective, **kwargs)
 
 
-class LGBMClassifier(LGBMModel):
+class LGBMClassifier(_SkClassifierMixin, LGBMModel):
     def __init__(self, objective: str = "binary", **kwargs):
         super().__init__(objective=objective, **kwargs)
 

@@ -77,3 +77,22 @@ def test_feature_importances(binary_example):
     imp = clf.feature_importances_
     assert imp.shape == (X.shape[1],)
     assert imp.sum() > 0
+
+
+def test_sklearn_clone_and_gridsearch():
+    """clone + GridSearchCV compatibility (reference test_sklearn.py
+    GridSearchCV / clone & property checks)."""
+    from sklearn.base import clone
+    from sklearn.model_selection import GridSearchCV
+    rng = np.random.RandomState(0)
+    X = rng.randn(600, 5)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(int)
+    clf = LGBMClassifier(n_estimators=8, num_leaves=7, verbose=-1)
+    c2 = clone(clf)
+    assert c2.get_params()["num_leaves"] == 7
+    assert c2.get_params()["verbose"] == -1  # kwargs survive clone
+    gs = GridSearchCV(clf, {"num_leaves": [7, 15]}, cv=2,
+                      scoring="accuracy")
+    gs.fit(X, y)
+    assert gs.best_score_ > 0.85
+    assert gs.best_params_["num_leaves"] in (7, 15)
