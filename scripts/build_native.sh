@@ -1,10 +1,12 @@
 #!/bin/sh
-# Build the native loader shared library (src/native/loader.cpp).
+# Build the native shared library (src/native/loader.cpp — fast text
+# parsing/binning, + src/native/c_api.cpp — the C inference ABI).
 # Output: lightgbm_tpu/lib/liblgbt_native.so — picked up automatically by
 # lightgbm_tpu/native.py; everything falls back to NumPy when absent.
 set -e
 cd "$(dirname "$0")/.."
 mkdir -p lightgbm_tpu/lib
 g++ -O3 -march=native -std=c++17 -shared -fPIC \
-    -o lightgbm_tpu/lib/liblgbt_native.so src/native/loader.cpp
+    -o lightgbm_tpu/lib/liblgbt_native.so \
+    src/native/loader.cpp src/native/c_api.cpp
 echo "built lightgbm_tpu/lib/liblgbt_native.so"

@@ -1,0 +1,438 @@
+// C inference API over the model-text contract.
+//
+// TPU-native counterpart of the reference C API's prediction surface
+// (/root/reference/include/LightGBM/c_api.h:37-711,
+// src/c_api.cpp Booster::Predict*): a C ABI that loads a saved model file
+// (the same text format gbdt.cpp:694-738 writes and our
+// boosting/gbdt.py:save_model_to_string emits) and predicts from dense
+// matrices — so deployment inference needs no Python runtime.  Training
+// stays Python/JAX-first (README "Not carried over"); this library covers
+// the part of the C ABI a non-Python consumer actually needs at serving
+// time: model load, raw/transformed prediction, and leaf indices.
+//
+// Semantics match lightgbm_tpu exactly (asserted from Python via ctypes in
+// tests/test_c_api.py):
+//   - tree i accumulates into class (i % num_tree_per_iteration)
+//     (boosting/gbdt.py predict_raw)
+//   - num_iteration limits trees like GBDT._num_used_models (the
+//     boost-from-average constant tree counts as one extra model)
+//   - numerical splits go left on (x <= threshold); NaN compares false and
+//     falls right, the same as the numpy walk (tree.py predict_leaf_index)
+//   - categorical splits go left on (int64)x == (int64)threshold
+//   - output transforms mirror objectives.py convert_output: binary /
+//     multiclassova sigmoid, multiclass softmax, identity otherwise.
+//
+// Build: scripts/build_native.sh (part of liblgbt_native.so).
+
+#include <charconv>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+// locale-free numeric parsing (a host app may setlocale() to a
+// comma-decimal locale; atof would then truncate "0.5" to 0)
+double parse_double(const std::string& s) {
+  double v = 0.0;
+  std::from_chars(s.data(), s.data() + s.size(), v);
+  return v;
+}
+
+int parse_int(const char* p) {
+  while (*p == ' ' || *p == '\t') ++p;
+  int v = 0;
+  std::from_chars(p, p + std::strlen(p), v);
+  return v;
+}
+
+thread_local std::string g_last_error;
+
+void set_error(const std::string& msg) { g_last_error = msg; }
+
+struct CTree {
+  int num_leaves = 1;
+  std::vector<int> split_feature;
+  std::vector<double> threshold;
+  std::vector<int8_t> decision_type;
+  std::vector<int> left_child;
+  std::vector<int> right_child;
+  std::vector<double> leaf_value;
+
+  // returns the leaf index reached by one row of raw feature values
+  int leaf(const double* x, int ncol) const {
+    if (num_leaves <= 1) return 0;
+    int node = 0;
+    while (node >= 0) {
+      int f = split_feature[node];
+      double v = (f < ncol) ? x[f] : 0.0;
+      bool left;
+      if (decision_type[node] == 0) {
+        left = v <= threshold[node];  // NaN -> false -> right, as in numpy
+      } else {
+        // NaN / out-of-int64-range values can never equal a stored
+        // category id; casting them would be UB, and the numpy walk's
+        // astype(int64) result for them (INT64_MIN) never matches either
+        left = v >= -9.2e18 && v <= 9.2e18 &&
+               static_cast<int64_t>(v) == static_cast<int64_t>(threshold[node]);
+      }
+      node = left ? left_child[node] : right_child[node];
+    }
+    return ~node;
+  }
+
+  double value(const double* x, int ncol) const {
+    return leaf_value[leaf(x, ncol)];
+  }
+};
+
+enum Transform { kIdentity, kSigmoid, kSoftmax };
+
+struct CBooster {
+  int num_class = 1;
+  int K = 1;  // num_tree_per_iteration
+  int max_feature_idx = 0;
+  bool boost_from_average = false;
+  Transform transform = kIdentity;
+  double sigmoid = 1.0;
+  std::vector<CTree> trees;
+
+  int used_models(int num_iteration) const {
+    int n = static_cast<int>(trees.size());
+    if (num_iteration > 0) {
+      int ni = num_iteration + (boost_from_average ? 1 : 0);
+      int cap = ni * (K > 0 ? K : 1);
+      if (cap < n) n = cap;
+    }
+    return n;
+  }
+};
+
+bool starts_with(const std::string& s, const char* p) {
+  return s.rfind(p, 0) == 0;
+}
+
+std::vector<std::string> split_ws(const std::string& s) {
+  std::vector<std::string> out;
+  size_t i = 0;
+  while (i < s.size()) {
+    while (i < s.size() && (s[i] == ' ' || s[i] == '\t')) ++i;
+    size_t j = i;
+    while (j < s.size() && s[j] != ' ' && s[j] != '\t') ++j;
+    if (j > i) out.push_back(s.substr(i, j - i));
+    i = j;
+  }
+  return out;
+}
+
+bool parse_tree(const std::vector<std::string>& lines, size_t begin,
+                size_t end, CTree* t) {
+  auto val = [&](const char* key) -> std::string {
+    std::string pre = std::string(key) + "=";
+    for (size_t i = begin; i < end; ++i)
+      if (starts_with(lines[i], pre.c_str()))
+        return lines[i].substr(pre.size());
+    return "";
+  };
+  std::string nl = val("num_leaves");
+  if (nl.empty()) return false;
+  t->num_leaves = parse_int(nl.c_str());
+  if (t->num_leaves <= 1) {
+    std::string lv = val("leaf_value");
+    t->leaf_value.assign(1, lv.empty() ? 0.0 : parse_double(lv));
+    return true;
+  }
+  int n = t->num_leaves;
+  auto ints = [&](const char* key, std::vector<int>* out) {
+    for (auto& tok : split_ws(val(key))) out->push_back(parse_int(tok.c_str()));
+  };
+  auto doubles = [&](const char* key, std::vector<double>* out) {
+    for (auto& tok : split_ws(val(key))) out->push_back(parse_double(tok));
+  };
+  ints("split_feature", &t->split_feature);
+  doubles("threshold", &t->threshold);
+  ints("left_child", &t->left_child);
+  ints("right_child", &t->right_child);
+  doubles("leaf_value", &t->leaf_value);
+  std::vector<int> dec;
+  ints("decision_type", &dec);
+  t->decision_type.assign(dec.begin(), dec.end());
+  if (t->decision_type.empty()) t->decision_type.assign(n - 1, 0);
+  if (static_cast<int>(t->split_feature.size()) != n - 1 ||
+      static_cast<int>(t->threshold.size()) != n - 1 ||
+      static_cast<int>(t->left_child.size()) != n - 1 ||
+      static_cast<int>(t->right_child.size()) != n - 1 ||
+      static_cast<int>(t->leaf_value.size()) != n) {
+    return false;
+  }
+  // structural validation: the walk in leaf() indexes these arrays
+  // unchecked, so a corrupt model must be rejected here, not segfault
+  // (or loop forever) at predict time.  Every internal node and leaf must
+  // be reachable exactly once from the root.
+  for (int i = 0; i < n - 1; ++i) {
+    if (t->split_feature[i] < 0) return false;
+    for (int c : {t->left_child[i], t->right_child[i]}) {
+      int leaf = ~c;
+      if (c >= 0 ? c >= n - 1 : leaf >= n) return false;
+    }
+  }
+  std::vector<char> seen_node(n - 1, 0), seen_leaf(n, 0);
+  std::vector<int> stack = {0};
+  while (!stack.empty()) {
+    int node = stack.back();
+    stack.pop_back();
+    if (node >= 0) {
+      if (seen_node[node]) return false;  // cycle / diamond
+      seen_node[node] = 1;
+      stack.push_back(t->left_child[node]);
+      stack.push_back(t->right_child[node]);
+    } else {
+      if (seen_leaf[~node]) return false;
+      seen_leaf[~node] = 1;
+    }
+  }
+  return true;
+}
+
+CBooster* parse_model(const std::string& text) {
+  std::vector<std::string> lines;
+  size_t pos = 0;
+  while (pos <= text.size()) {
+    size_t nl = text.find('\n', pos);
+    if (nl == std::string::npos) nl = text.size();
+    std::string line = text.substr(pos, nl - pos);
+    if (!line.empty() && line.back() == '\r') line.pop_back();
+    lines.push_back(line);
+    pos = nl + 1;
+  }
+  auto* b = new CBooster();
+  size_t first_tree = lines.size();
+  for (size_t i = 0; i < lines.size(); ++i) {
+    const std::string& ln = lines[i];
+    if (starts_with(ln, "Tree=")) { first_tree = i; break; }
+    if (starts_with(ln, "num_class="))
+      b->num_class = parse_int(ln.c_str() + 10);
+    else if (starts_with(ln, "num_tree_per_iteration="))
+      b->K = parse_int(ln.c_str() + 23);
+    else if (starts_with(ln, "max_feature_idx="))
+      b->max_feature_idx = parse_int(ln.c_str() + 16);
+    else if (ln == "boost_from_average")
+      b->boost_from_average = true;
+    else if (starts_with(ln, "objective=")) {
+      std::string obj = ln.substr(10);
+      if (starts_with(obj, "binary") || starts_with(obj, "multiclassova"))
+        b->transform = kSigmoid;
+      else if (starts_with(obj, "multiclass"))
+        b->transform = kSoftmax;
+      size_t sp = obj.find("sigmoid:");
+      if (sp != std::string::npos)
+        b->sigmoid = parse_double(obj.substr(sp + 8));
+    }
+  }
+  if (b->K <= 0) b->K = b->num_class;
+  // tree blocks run from each "Tree=i" to the next one (or the
+  // "feature importances:" trailer)
+  size_t stop = lines.size();
+  for (size_t i = first_tree; i < lines.size(); ++i)
+    if (lines[i] == "feature importances:") { stop = i; break; }
+  std::vector<size_t> starts;
+  for (size_t i = first_tree; i < stop; ++i)
+    if (starts_with(lines[i], "Tree=")) starts.push_back(i);
+  for (size_t k = 0; k < starts.size(); ++k) {
+    size_t begin = starts[k] + 1;
+    size_t end = (k + 1 < starts.size()) ? starts[k + 1] : stop;
+    CTree t;
+    if (!parse_tree(lines, begin, end, &t)) {
+      set_error("malformed tree block at model line " +
+                std::to_string(starts[k] + 1));
+      delete b;
+      return nullptr;
+    }
+    b->trees.push_back(std::move(t));
+  }
+  return b;
+}
+
+// reference c_api.h dtype / predict-type constants
+constexpr int kDtypeF32 = 0;
+constexpr int kDtypeF64 = 1;
+constexpr int kPredictNormal = 0;
+constexpr int kPredictRaw = 1;
+constexpr int kPredictLeaf = 2;
+
+}  // namespace
+
+extern "C" {
+
+const char* LGBM_GetLastError() { return g_last_error.c_str(); }
+
+int LGBM_BoosterLoadModelFromString(const char* model_str,
+                                    int* out_num_iterations,
+                                    void** out_handle) {
+  if (!model_str || !out_handle) {
+    set_error("null argument");
+    return -1;
+  }
+  try {
+    CBooster* b = parse_model(model_str);
+    if (!b) return -1;
+    if (out_num_iterations) {
+      int extra = b->boost_from_average ? 1 : 0;
+      *out_num_iterations =
+          (static_cast<int>(b->trees.size()) - extra) / (b->K > 0 ? b->K : 1);
+    }
+    *out_handle = b;
+    return 0;
+  } catch (const std::exception& e) {
+    // exceptions must not cross the C ABI (the caller may not even be C++)
+    set_error(std::string("model parse failed: ") + e.what());
+    return -1;
+  }
+}
+
+int LGBM_BoosterCreateFromModelfile(const char* filename,
+                                    int* out_num_iterations,
+                                    void** out_handle) {
+  if (!filename || !out_handle) {
+    set_error("null argument");
+    return -1;
+  }
+  FILE* fp = std::fopen(filename, "rb");
+  if (!fp) {
+    set_error(std::string("cannot open model file: ") + filename);
+    return -1;
+  }
+  long size = -1;
+  if (std::fseek(fp, 0, SEEK_END) == 0) size = std::ftell(fp);
+  if (size < 0 || std::fseek(fp, 0, SEEK_SET) != 0) {
+    std::fclose(fp);
+    set_error(std::string("cannot seek model file (pipe?): ") + filename);
+    return -1;
+  }
+  std::string text;
+  try {
+    text.resize(static_cast<size_t>(size));
+  } catch (const std::exception&) {
+    std::fclose(fp);
+    set_error(std::string("model file too large: ") + filename);
+    return -1;
+  }
+  size_t got =
+      size ? std::fread(&text[0], 1, static_cast<size_t>(size), fp) : 0;
+  std::fclose(fp);
+  if (got != static_cast<size_t>(size)) {
+    set_error(std::string("short read on model file: ") + filename);
+    return -1;
+  }
+  return LGBM_BoosterLoadModelFromString(text.c_str(), out_num_iterations,
+                                         out_handle);
+}
+
+int LGBM_BoosterFree(void* handle) {
+  delete static_cast<CBooster*>(handle);
+  return 0;
+}
+
+int LGBM_BoosterGetNumClasses(void* handle, int* out_len) {
+  if (!handle || !out_len) {
+    set_error("null argument");
+    return -1;
+  }
+  *out_len = static_cast<CBooster*>(handle)->num_class;
+  return 0;
+}
+
+int LGBM_BoosterGetNumFeature(void* handle, int* out_len) {
+  if (!handle || !out_len) {
+    set_error("null argument");
+    return -1;
+  }
+  *out_len = static_cast<CBooster*>(handle)->max_feature_idx + 1;
+  return 0;
+}
+
+int LGBM_BoosterNumberOfTotalModel(void* handle, int* out_models) {
+  if (!handle || !out_models) {
+    set_error("null argument");
+    return -1;
+  }
+  *out_models = static_cast<int>(static_cast<CBooster*>(handle)->trees.size());
+  return 0;
+}
+
+int LGBM_BoosterPredictForMat(void* handle, const void* data, int data_type,
+                              int32_t nrow, int32_t ncol, int is_row_major,
+                              int predict_type, int num_iteration,
+                              int64_t* out_len, double* out_result) {
+  if (!handle || !data || !out_result) {
+    set_error("null argument");
+    return -1;
+  }
+  if (data_type != kDtypeF32 && data_type != kDtypeF64) {
+    set_error("data_type must be 0 (float32) or 1 (float64)");
+    return -1;
+  }
+  if (nrow < 0 || ncol < 0) {
+    set_error("negative nrow/ncol");
+    return -1;
+  }
+  try {
+  const CBooster& b = *static_cast<CBooster*>(handle);
+  const int used = b.used_models(num_iteration);
+  const int K = b.K > 0 ? b.K : 1;
+  std::vector<double> row(ncol);
+  auto load_row = [&](int32_t r) {
+    for (int32_t c = 0; c < ncol; ++c) {
+      size_t idx = is_row_major
+                       ? static_cast<size_t>(r) * ncol + c
+                       : static_cast<size_t>(c) * nrow + r;
+      row[c] = (data_type == kDtypeF32)
+                   ? static_cast<const float*>(data)[idx]
+                   : static_cast<const double*>(data)[idx];
+    }
+  };
+
+  if (predict_type == kPredictLeaf) {
+    for (int32_t r = 0; r < nrow; ++r) {
+      load_row(r);
+      for (int i = 0; i < used; ++i)
+        out_result[static_cast<size_t>(r) * used + i] =
+            b.trees[i].leaf(row.data(), ncol);
+    }
+    if (out_len) *out_len = static_cast<int64_t>(nrow) * used;
+    return 0;
+  }
+
+  std::vector<double> score(K);
+  for (int32_t r = 0; r < nrow; ++r) {
+    load_row(r);
+    std::fill(score.begin(), score.end(), 0.0);
+    for (int i = 0; i < used; ++i)
+      score[i % K] += b.trees[i].value(row.data(), ncol);
+    if (predict_type == kPredictNormal) {
+      if (b.transform == kSigmoid) {
+        for (int k = 0; k < K; ++k)
+          score[k] = 1.0 / (1.0 + std::exp(-b.sigmoid * score[k]));
+      } else if (b.transform == kSoftmax) {
+        double m = score[0];
+        for (int k = 1; k < K; ++k) m = std::max(m, score[k]);
+        double s = 0.0;
+        for (int k = 0; k < K; ++k) s += (score[k] = std::exp(score[k] - m));
+        for (int k = 0; k < K; ++k) score[k] /= s;
+      }
+    }
+    for (int k = 0; k < K; ++k)
+      out_result[static_cast<size_t>(r) * K + k] = score[k];
+  }
+  if (out_len) *out_len = static_cast<int64_t>(nrow) * K;
+  return 0;
+  } catch (const std::exception& e) {
+    set_error(std::string("predict failed: ") + e.what());
+    return -1;
+  }
+}
+
+}  // extern "C"

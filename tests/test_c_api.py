@@ -1,0 +1,258 @@
+"""C inference ABI (src/native/c_api.cpp) vs the Python predictor.
+
+The reference exposes prediction to non-Python consumers through the C API
+(c_api.h LGBM_BoosterCreateFromModelfile / LGBM_BoosterPredictForMat); these
+tests drive our native library through the same entry points via ctypes and
+assert exact agreement with `Booster.predict` on the same model file.
+"""
+import ctypes
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import native
+
+
+def _capi():
+    lib = native.get_lib()
+    if lib is None:
+        pytest.skip("native library not built")
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+    lib.LGBM_BoosterCreateFromModelfile.restype = ctypes.c_int
+    lib.LGBM_BoosterCreateFromModelfile.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_void_p)]
+    lib.LGBM_BoosterFree.argtypes = [ctypes.c_void_p]
+    lib.LGBM_BoosterGetNumClasses.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int)]
+    lib.LGBM_BoosterGetNumFeature.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int)]
+    lib.LGBM_BoosterNumberOfTotalModel.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int)]
+    lib.LGBM_BoosterPredictForMat.restype = ctypes.c_int
+    lib.LGBM_BoosterPredictForMat.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int64),
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")]
+    return lib
+
+
+def _load(lib, path):
+    handle = ctypes.c_void_p()
+    iters = ctypes.c_int()
+    rc = lib.LGBM_BoosterCreateFromModelfile(
+        path.encode(), ctypes.byref(iters), ctypes.byref(handle))
+    assert rc == 0, lib.LGBM_GetLastError()
+    return handle, iters.value
+
+
+def _predict(lib, handle, X, predict_type=0, num_iteration=-1, out_cols=1):
+    X = np.ascontiguousarray(X, np.float64)
+    n = X.shape[0]
+    out = np.empty(n * out_cols, np.float64)
+    out_len = ctypes.c_int64()
+    rc = lib.LGBM_BoosterPredictForMat(
+        handle, X.ctypes.data_as(ctypes.c_void_p), 1, n, X.shape[1], 1,
+        predict_type, num_iteration, ctypes.byref(out_len), out)
+    assert rc == 0, lib.LGBM_GetLastError()
+    assert out_len.value == n * out_cols
+    return out.reshape(n, out_cols)
+
+
+def _problem(seed=11, n=400, f=6, classes=2):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    if classes == 2:
+        y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] > 0).astype(np.float64)
+    else:
+        y = (np.digitize(X[:, 0], [-0.5, 0.5])).astype(np.float64)
+    return X, y
+
+
+@pytest.mark.quick
+def test_binary_matches_python(tmp_path):
+    lib = _capi()
+    X, y = _problem()
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "min_data_in_leaf": 5, "verbose": -1},
+                    lgb.Dataset(X, y), num_boost_round=8)
+    path = str(tmp_path / "model.txt")
+    bst.save_model(path)
+    handle, iters = _load(lib, path)
+    try:
+        assert iters == 8
+        nc = ctypes.c_int()
+        lib.LGBM_BoosterGetNumClasses(handle, ctypes.byref(nc))
+        assert nc.value == 1
+        nf = ctypes.c_int()
+        lib.LGBM_BoosterGetNumFeature(handle, ctypes.byref(nf))
+        assert nf.value == X.shape[1]
+        Xt = np.random.RandomState(3).randn(200, X.shape[1])
+        got = _predict(lib, handle, Xt)[:, 0]
+        want = bst.predict(Xt)
+        np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-15)
+        got_raw = _predict(lib, handle, Xt, predict_type=1)[:, 0]
+        want_raw = bst._gbdt.predict_raw(Xt)
+        np.testing.assert_allclose(got_raw, want_raw, rtol=1e-12, atol=1e-15)
+        # float32 input goes through the same walk
+        got32 = np.empty(200, np.float64)
+        out_len = ctypes.c_int64()
+        X32 = np.ascontiguousarray(Xt, np.float32)
+        rc = lib.LGBM_BoosterPredictForMat(
+            handle, X32.ctypes.data_as(ctypes.c_void_p), 0, 200, X.shape[1],
+            1, 1, -1, ctypes.byref(out_len), got32)
+        assert rc == 0
+        want32 = bst._gbdt.predict_raw(X32.astype(np.float64))
+        np.testing.assert_allclose(got32, want32, rtol=1e-12, atol=1e-15)
+    finally:
+        lib.LGBM_BoosterFree(handle)
+
+
+@pytest.mark.quick
+def test_num_iteration_and_leaf_match(tmp_path):
+    lib = _capi()
+    X, y = _problem(seed=12)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "min_data_in_leaf": 5, "verbose": -1},
+                    lgb.Dataset(X, y), num_boost_round=6)
+    path = str(tmp_path / "model.txt")
+    bst.save_model(path)
+    handle, _ = _load(lib, path)
+    try:
+        Xt = np.random.RandomState(4).randn(50, X.shape[1])
+        got = _predict(lib, handle, Xt, predict_type=1, num_iteration=3)[:, 0]
+        want = bst._gbdt.predict_raw(Xt, num_iteration=3)
+        np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-15)
+        nm = ctypes.c_int()
+        lib.LGBM_BoosterNumberOfTotalModel(handle, ctypes.byref(nm))
+        got_leaf = _predict(lib, handle, Xt, predict_type=2,
+                            out_cols=nm.value)
+        want_leaf = bst._gbdt.predict_leaf_index(Xt)
+        np.testing.assert_array_equal(got_leaf.astype(np.int32), want_leaf)
+    finally:
+        lib.LGBM_BoosterFree(handle)
+
+
+@pytest.mark.quick
+def test_multiclass_matches_python(tmp_path):
+    lib = _capi()
+    X, y = _problem(seed=13, classes=3)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 7, "min_data_in_leaf": 5, "verbose": -1},
+                    lgb.Dataset(X, y), num_boost_round=5)
+    path = str(tmp_path / "model.txt")
+    bst.save_model(path)
+    handle, iters = _load(lib, path)
+    try:
+        assert iters == 5
+        nc = ctypes.c_int()
+        lib.LGBM_BoosterGetNumClasses(handle, ctypes.byref(nc))
+        assert nc.value == 3
+        Xt = np.random.RandomState(5).randn(80, X.shape[1])
+        got = _predict(lib, handle, Xt, out_cols=3)
+        want = bst.predict(Xt)
+        np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-15)
+    finally:
+        lib.LGBM_BoosterFree(handle)
+
+
+@pytest.mark.quick
+def test_regression_and_column_major(tmp_path):
+    lib = _capi()
+    rng = np.random.RandomState(14)
+    X = rng.randn(300, 5)
+    y = X[:, 0] * 2 + np.sin(X[:, 1]) + 0.1 * rng.randn(300)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "min_data_in_leaf": 5, "verbose": -1},
+                    lgb.Dataset(X, y), num_boost_round=6)
+    path = str(tmp_path / "model.txt")
+    bst.save_model(path)
+    handle, _ = _load(lib, path)
+    try:
+        Xt = rng.randn(60, 5)
+        want = bst.predict(Xt)
+        got = _predict(lib, handle, Xt)[:, 0]
+        np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-15)
+        # column-major input
+        Xf = np.asfortranarray(Xt)
+        out = np.empty(60, np.float64)
+        out_len = ctypes.c_int64()
+        rc = lib.LGBM_BoosterPredictForMat(
+            handle, Xf.ctypes.data_as(ctypes.c_void_p), 1, 60, 5, 0, 0, -1,
+            ctypes.byref(out_len), out)
+        assert rc == 0
+        np.testing.assert_allclose(out, want, rtol=1e-12, atol=1e-15)
+    finally:
+        lib.LGBM_BoosterFree(handle)
+
+
+@pytest.mark.quick
+def test_categorical_splits_match_python(tmp_path):
+    lib = _capi()
+    rng = np.random.RandomState(15)
+    n = 500
+    cat = rng.randint(0, 5, n).astype(np.float64)
+    Xnum = rng.randn(n, 3)
+    X = np.column_stack([cat, Xnum])
+    y = (np.isin(cat, [1, 3]).astype(np.float64) * 2 + Xnum[:, 0]
+         + 0.1 * rng.randn(n))
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "min_data_in_leaf": 5, "verbose": -1},
+                    lgb.Dataset(X, y), num_boost_round=8,
+                    categorical_feature=[0])
+    assert any(t.has_categorical for t in bst._gbdt.models), \
+        "fixture failed to produce a categorical split"
+    path = str(tmp_path / "model.txt")
+    bst.save_model(path)
+    handle, _ = _load(lib, path)
+    try:
+        Xt = np.column_stack([rng.randint(0, 6, 100).astype(np.float64),
+                              rng.randn(100, 3)])
+        # NaN in the categorical column must fall right, like the numpy walk
+        Xt[::7, 0] = np.nan
+        want = bst.predict(Xt)
+        got = _predict(lib, handle, Xt)[:, 0]
+        np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-15)
+    finally:
+        lib.LGBM_BoosterFree(handle)
+
+
+@pytest.mark.quick
+def test_corrupt_model_rejected(tmp_path):
+    lib = _capi()
+    # child index out of range must be rejected at load, not segfault at
+    # predict
+    bad = ("tree\nnum_class=1\nnum_tree_per_iteration=1\n"
+           "max_feature_idx=3\n\nTree=0\nnum_leaves=3\n"
+           "split_feature=0 1\nthreshold=0.5 0.5\ndecision_type=0 0\n"
+           "left_child=-1 5\nright_child=1 -2\n"
+           "leaf_value=0.1 0.2 0.3\nshrinkage=1\n")
+    p = tmp_path / "bad.txt"
+    p.write_text(bad)
+    handle = ctypes.c_void_p()
+    iters = ctypes.c_int()
+    rc = lib.LGBM_BoosterCreateFromModelfile(
+        str(p).encode(), ctypes.byref(iters), ctypes.byref(handle))
+    assert rc == -1
+    assert b"malformed" in lib.LGBM_GetLastError()
+    # a child cycle must also be rejected (it would loop forever)
+    bad2 = bad.replace("left_child=-1 5", "left_child=1 0")
+    p2 = tmp_path / "bad2.txt"
+    p2.write_text(bad2)
+    rc = lib.LGBM_BoosterCreateFromModelfile(
+        str(p2).encode(), ctypes.byref(iters), ctypes.byref(handle))
+    assert rc == -1
+
+
+@pytest.mark.quick
+def test_bad_model_file_reports_error():
+    lib = _capi()
+    handle = ctypes.c_void_p()
+    iters = ctypes.c_int()
+    rc = lib.LGBM_BoosterCreateFromModelfile(
+        b"/nonexistent/model.txt", ctypes.byref(iters), ctypes.byref(handle))
+    assert rc == -1
+    assert b"cannot open" in lib.LGBM_GetLastError()
