@@ -15,6 +15,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops.lookup import table_lookup
+
 
 @functools.partial(jax.jit, static_argnames=("depth",))
 def predict_binned_leaf(bins_t: jax.Array, split_feature_inner: jax.Array,
@@ -80,13 +82,19 @@ def traverse_tree_device(bins_t, split_feature, threshold_bin, is_cat,
 
 @jax.jit
 def _add_from_leaf(score_row, leaf_idx, leaf_values):
-    return score_row + leaf_values[leaf_idx]
+    # one-hot matmul, not table gather: XLA's [N] gather from a leaf-sized
+    # table runs at <1 GB/s on TPU (see ops/lookup.py) and cost ~65 ms per
+    # iteration at N=4M; the matmul is exact for f32 leaf values
+    val = table_lookup(leaf_values[None], leaf_idx,
+                       num_slots=leaf_values.shape[0])[0]
+    return score_row + val
 
 
 @jax.jit
 def _add_from_leaf_masked(score_row, leaf_id, leaf_values):
-    val = leaf_values[jnp.maximum(leaf_id, 0)]
-    return score_row + jnp.where(leaf_id >= 0, val, 0.0)
+    # out-of-bag rows carry leaf_id -1, which matches no one-hot slot and
+    # therefore contributes exactly 0.0 — no separate mask needed
+    return _add_from_leaf(score_row, leaf_id, leaf_values)
 
 
 class ScoreUpdater:

@@ -43,6 +43,7 @@ from .common import (make_split_kw, padded_bin_count, sentinel_bins_t,
                      use_parent_hist_cache)
 from .fused import TreeArrays, tree_arrays_to_host
 from ..ops.histogram import hist_multileaf_masked
+from ..ops.lookup import table_lookup
 from ..ops.split import best_split, leaf_output
 from ..tree import Tree
 
@@ -169,28 +170,30 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
         r_sums = rec[:, 6:9]
 
         # ---- partition all rows in one pass -------------------------------
-        # per-LEAF lookup, bit-packed into two int32 tables so the [Nloc]
-        # table gather happens twice, not five times (~4 ms each at 1M):
-        #   t1 = feat << 16 | thr          (feat < 2^15, thr < 2^16)
-        #   t2 = cat << 16 | new_leaf      (new_leaf > 0 ⟺ leaf splits;
-        #                                   leaf 0 is never a NEW leaf)
+        # per-LEAF lookup of (split feature, threshold, is-cat, new leaf)
+        # as ONE one-hot matmul (ops/lookup.py): XLA's [Nloc] table gather
+        # runs at <1 GB/s on TPU and cost more than the histogram kernel
+        # (65 ms/table at N=4M vs 5 ms for the matmul, which is exact for
+        # integer-valued f32 tables; new_leaf > 0 ⟺ leaf splits, leaf 0
+        # is never a NEW leaf)
         tbl_idx = jnp.where(do, pl_, L)                      # drop-slot L
-        t1 = jnp.zeros(L + 1, jnp.int32).at[tbl_idx].set(
-            (feat << 16) | thr, mode="drop")
-        t2 = jnp.zeros(L + 1, jnp.int32).at[tbl_idx].set(
-            (catf.astype(jnp.int32) << 16) | new_leaf, mode="drop")
-
-        r1 = t1[leaf_id]                                     # [Nloc]
-        r2 = t2[leaf_id]
-        fi = r1 >> 16
-        ti = r1 & 0xFFFF
-        ci = (r2 >> 16) > 0
-        nli = r2 & 0xFFFF
-        # row's split-feature bin via masked accumulate over features
-        # (avoids a minor-axis 2-D gather; F passes on the VPU)
-        def pick(f, acc):
-            return acc + jnp.where(fi == f, binsf[f], 0)
-        vi = jax.lax.fori_loop(0, F, pick, jnp.zeros(Nloc, jnp.int32))
+        zeros = jnp.zeros(L + 1, jnp.float32)
+        tbl = jnp.stack([
+            zeros.at[tbl_idx].set(feat.astype(jnp.float32), mode="drop"),
+            zeros.at[tbl_idx].set(thr.astype(jnp.float32), mode="drop"),
+            zeros.at[tbl_idx].set(catf.astype(jnp.float32), mode="drop"),
+            zeros.at[tbl_idx].set(new_leaf.astype(jnp.float32),
+                                  mode="drop")])
+        r = table_lookup(tbl, leaf_id, num_slots=L + 1)      # [4, Nloc]
+        fi = r[0].astype(jnp.int32)
+        ti = r[1].astype(jnp.int32)
+        ci = r[2] > 0
+        nli = r[3].astype(jnp.int32)
+        # row's split-feature bin via a masked sum over features — a single
+        # fused compare/select/reduce pass (avoids a minor-axis 2-D gather
+        # AND the F-step fori_loop's accumulator round-trips)
+        vi = jnp.sum(jnp.where(fi[None, :] == jax.lax.broadcasted_iota(
+            jnp.int32, (F, 1), 0), binsf, 0), axis=0)
         gl = jnp.where(ci, vi == ti, vi <= ti)
         leaf_id2 = jnp.where((nli > 0) & ~gl, nli, leaf_id)
 

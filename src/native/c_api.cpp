@@ -161,7 +161,8 @@ bool parse_tree(const std::vector<std::string>& lines, size_t begin,
   ints("decision_type", &dec);
   t->decision_type.assign(dec.begin(), dec.end());
   if (t->decision_type.empty()) t->decision_type.assign(n - 1, 0);
-  if (static_cast<int>(t->split_feature.size()) != n - 1 ||
+  if (static_cast<int>(t->decision_type.size()) != n - 1 ||
+      static_cast<int>(t->split_feature.size()) != n - 1 ||
       static_cast<int>(t->threshold.size()) != n - 1 ||
       static_cast<int>(t->left_child.size()) != n - 1 ||
       static_cast<int>(t->right_child.size()) != n - 1 ||
@@ -381,6 +382,13 @@ int LGBM_BoosterPredictForMat(void* handle, const void* data, int data_type,
   }
   try {
   const CBooster& b = *static_cast<CBooster*>(handle);
+  if (ncol < b.max_feature_idx + 1) {
+    // silently treating missing columns as 0.0 would return wrong
+    // predictions with rc=0; the Python walk raises on the same input
+    set_error("ncol (" + std::to_string(ncol) + ") < model features (" +
+              std::to_string(b.max_feature_idx + 1) + ")");
+    return -1;
+  }
   const int used = b.used_models(num_iteration);
   const int K = b.K > 0 ? b.K : 1;
   std::vector<double> row(ncol);

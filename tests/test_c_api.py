@@ -248,6 +248,47 @@ def test_corrupt_model_rejected(tmp_path):
 
 
 @pytest.mark.quick
+def test_ncol_mismatch_and_truncated_decision_type(tmp_path):
+    lib = _capi()
+    X, y = _problem(seed=16)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "min_data_in_leaf": 5, "verbose": -1},
+                    lgb.Dataset(X, y), num_boost_round=2)
+    path = str(tmp_path / "model.txt")
+    bst.save_model(path)
+    handle, _ = _load(lib, path)
+    try:
+        # fewer columns than the model's features must error, not predict
+        Xs = np.ascontiguousarray(np.random.RandomState(6).randn(10, 3))
+        out = np.empty(10, np.float64)
+        out_len = ctypes.c_int64()
+        rc = lib.LGBM_BoosterPredictForMat(
+            handle, Xs.ctypes.data_as(ctypes.c_void_p), 1, 10, 3, 1, 0, -1,
+            ctypes.byref(out_len), out)
+        assert rc == -1
+        assert b"model features" in lib.LGBM_GetLastError()
+    finally:
+        lib.LGBM_BoosterFree(handle)
+    # a decision_type line with too few tokens must be rejected at load
+    txt = open(path).read()
+    lines = txt.splitlines()
+    for i, ln in enumerate(lines):
+        if ln.startswith("decision_type="):
+            toks = ln.split("=", 1)[1].split()
+            if len(toks) > 1:
+                lines[i] = "decision_type=" + " ".join(toks[:-1])
+                break
+    p2 = tmp_path / "trunc.txt"
+    p2.write_text("\n".join(lines) + "\n")
+    handle = ctypes.c_void_p()
+    iters = ctypes.c_int()
+    rc = lib.LGBM_BoosterCreateFromModelfile(
+        str(p2).encode(), ctypes.byref(iters), ctypes.byref(handle))
+    assert rc == -1
+    assert b"malformed" in lib.LGBM_GetLastError()
+
+
+@pytest.mark.quick
 def test_bad_model_file_reports_error():
     lib = _capi()
     handle = ctypes.c_void_p()
