@@ -3,8 +3,11 @@
 Reference: /root/reference/src/boosting/score_updater.hpp (three AddScore
 paths: whole-data tree predict, leaf-partition fast path for train, and
 constant adds).  Tree traversal over the BINNED matrix is a vectorized
-node-walk (one gather per depth level) instead of the reference's per-row
-pointer chase (tree.cpp:99-192) — all rows advance one tree level per step.
+node-walk instead of the reference's per-row pointer chase
+(tree.cpp:99-192): all rows advance one tree level per step, with each
+level's per-node fields fetched by ONE one-hot matmul (ops/lookup.py) and
+the row's split-feature bin by a fused masked sum — no gathers, which
+serialize on TPU.
 """
 from __future__ import annotations
 
@@ -15,7 +18,29 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.lookup import table_lookup
+from ..ops.lookup import select_bin_by_feature, table_lookup
+
+
+def _walk_step(node, bins_nt, split_feature, threshold, decision,
+               left_child, right_child, num_nodes):
+    """One tree level for every row at once.  All per-node lookups go
+    through the one-hot matmul (ops/lookup.py) — XLA's [N] table gathers
+    and 2-D `bins[rows, feat]` gathers serialize on TPU and cost more than
+    the whole histogram pass; child ids are exact in f32 (|v| < 2^24)."""
+    nd = jnp.maximum(node, 0)
+    tbl = jnp.stack([split_feature.astype(jnp.float32),
+                     threshold.astype(jnp.float32),
+                     decision.astype(jnp.float32),
+                     left_child.astype(jnp.float32),
+                     right_child.astype(jnp.float32)])
+    r = table_lookup(tbl, nd, num_slots=num_nodes)
+    feat = r[0].astype(jnp.int32)
+    t = r[1].astype(jnp.int32)
+    d = r[2]
+    bv = select_bin_by_feature(bins_nt.T, feat)
+    go_left = jnp.where(d == 1, bv == t, bv <= t)
+    nxt = jnp.where(go_left, r[3], r[4]).astype(jnp.int32)
+    return jnp.where(node < 0, node, nxt)
 
 
 @functools.partial(jax.jit, static_argnames=("depth",))
@@ -30,18 +55,13 @@ def predict_binned_leaf(bins_t: jax.Array, split_feature_inner: jax.Array,
     """
     N = bins_t.shape[0] - 1
     node = jnp.zeros(N, jnp.int32)
-    rows = jnp.arange(N)
+    bins_nt = bins_t[:N]
+    nn = split_feature_inner.shape[0]
 
     def step(_, node):
-        is_leaf = node < 0
-        nd = jnp.maximum(node, 0)
-        feat = split_feature_inner[nd]
-        bv = bins_t[rows, feat].astype(jnp.int32)
-        t = threshold_in_bin[nd]
-        d = decision_type[nd]
-        go_left = jnp.where(d == 1, bv == t, bv <= t)
-        nxt = jnp.where(go_left, left_child[nd], right_child[nd])
-        return jnp.where(is_leaf, node, nxt)
+        return _walk_step(node, bins_nt, split_feature_inner,
+                          threshold_in_bin, decision_type, left_child,
+                          right_child, nn)
 
     node = jax.lax.fori_loop(0, max(depth, 1), step, node)
     return ~node
@@ -56,7 +76,6 @@ def traverse_tree_device(bins_t, split_feature, threshold_bin, is_cat,
     every row parked at a leaf (negative node), so cost tracks the actual
     tree depth instead of a static worst-case bound."""
     N = bins_t.shape[0] - 1
-    rows = jnp.arange(N)
     # stump: everything is leaf 0 (node -1 == ~0) from the start
     n0 = jnp.where(num_leaves < 2, jnp.int32(-1), jnp.int32(0))
     node = jnp.full(N, n0, jnp.int32)
@@ -66,15 +85,14 @@ def traverse_tree_device(bins_t, split_feature, threshold_bin, is_cat,
         i, node = st
         return (i < max_steps) & jnp.any(node >= 0)
 
+    bins_nt = bins_t[:N]
+    nn = split_feature.shape[0]
+
     def body(st):
         i, node = st
-        nd = jnp.maximum(node, 0)
-        feat = split_feature[nd]
-        bv = bins_t[rows, feat].astype(jnp.int32)
-        t = threshold_bin[nd]
-        go_left = jnp.where(is_cat[nd], bv == t, bv <= t)
-        nxt = jnp.where(go_left, left_child[nd], right_child[nd])
-        return i + 1, jnp.where(node < 0, node, nxt)
+        node = _walk_step(node, bins_nt, split_feature, threshold_bin,
+                          is_cat, left_child, right_child, nn)
+        return i + 1, node
 
     _, node = jax.lax.while_loop(cond, body, (jnp.int32(0), node))
     return ~node

@@ -543,7 +543,9 @@ def create_tree_learner(dataset: Dataset, config: Config):
       single-split builder (no per-split host syncs).
     - "rounds": batched rounds (learner/rounds.py) — the MXU-efficient
       schedule; equals leaf-wise whenever the num_leaves cap doesn't bind.
-    - "auto": rounds on TPU, exact elsewhere.
+    - "auto": rounds on TPU, exact elsewhere (the masked multi-leaf
+      formulation is matmul-heavy — right for the MXU, wasteful on CPU,
+      where the gather-based exact learner is work-optimal).
     """
     lt = getattr(config, "tree_learner", "serial")
     growth = getattr(config, "tree_growth", "auto")

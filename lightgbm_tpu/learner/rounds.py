@@ -43,7 +43,7 @@ from .common import (make_split_kw, padded_bin_count, sentinel_bins_t,
                      use_parent_hist_cache)
 from .fused import TreeArrays, tree_arrays_to_host
 from ..ops.histogram import hist_multileaf_masked
-from ..ops.lookup import table_lookup
+from ..ops.lookup import select_bin_by_feature, table_lookup
 from ..ops.split import best_split, leaf_output
 from ..tree import Tree
 
@@ -189,11 +189,7 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
         ti = r[1].astype(jnp.int32)
         ci = r[2] > 0
         nli = r[3].astype(jnp.int32)
-        # row's split-feature bin via a masked sum over features — a single
-        # fused compare/select/reduce pass (avoids a minor-axis 2-D gather
-        # AND the F-step fori_loop's accumulator round-trips)
-        vi = jnp.sum(jnp.where(fi[None, :] == jax.lax.broadcasted_iota(
-            jnp.int32, (F, 1), 0), binsf, 0), axis=0)
+        vi = select_bin_by_feature(binsf, fi)
         gl = jnp.where(ci, vi == ti, vi <= ti)
         leaf_id2 = jnp.where((nli > 0) & ~gl, nli, leaf_id)
 

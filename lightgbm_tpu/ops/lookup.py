@@ -42,3 +42,16 @@ def table_lookup(tables: jax.Array, ids: jax.Array, *,
 
     _, out = jax.lax.scan(body, None, idp.reshape(nch, C))
     return out.transpose(1, 0, 2).reshape(T, nch * C)[:, :N]
+
+
+def select_bin_by_feature(bins_fn: jax.Array, fi: jax.Array) -> jax.Array:
+    """Per-row bin of that row's feature: bins_fn [F, N] int, fi [N] int32
+    → [N] int32 (rows whose fi matches no feature yield 0).
+
+    A single fused compare/select/reduce pass over the feature axis — the
+    alternative, a minor-axis 2-D gather `bins[fi, rows]`, serializes on
+    TPU just like the table gathers above.
+    """
+    F = bins_fn.shape[0]
+    return jnp.sum(jnp.where(fi[None, :] == jax.lax.broadcasted_iota(
+        jnp.int32, (F, 1), 0), bins_fn.astype(jnp.int32), 0), axis=0)

@@ -26,15 +26,29 @@ def _train(params, data, rounds=25, feval=None, fobj=None, init_model=None):
     return bst, ev["valid_0"]
 
 
-def test_multiclass(multiclass_example):
+@pytest.mark.slow
+def test_multiclass_parity(multiclass_example):
+    """Full-length reference-parity run (the reference binary reaches
+    1.39606 on this dataset/config; we get 1.3959).  `slow` tier — the
+    default tier covers the same code path via test_multiclass below."""
     X, y, Xt, yt = multiclass_example
     params = {"objective": "multiclass", "num_class": 5,
               "metric": "multi_logloss", "verbose": -1,
               "min_data_in_leaf": 10}
     bst, res = _train(params, (X, y, Xt, yt), rounds=30)
-    # the reference binary reaches 1.39606 on this dataset/config; we get
-    # 1.3959 — parity, the dataset is just hard
     assert res["multi_logloss"][-1] < 1.45
+
+
+def test_multiclass(multiclass_example):
+    X, y, Xt, yt = multiclass_example
+    params = {"objective": "multiclass", "num_class": 5,
+              "metric": "multi_logloss", "verbose": -1,
+              "min_data_in_leaf": 10}
+    bst, res = _train(params, (X, y, Xt, yt), rounds=10)
+    # 10-round shape/trajectory check (measured 1.5192 on this host); the
+    # reference-parity threshold lives in test_multiclass_parity
+    assert res["multi_logloss"][-1] < 1.56
+    assert res["multi_logloss"][-1] < res["multi_logloss"][0] - 0.05
     p = bst.predict(Xt)
     assert p.shape == (len(yt), 5)
     np.testing.assert_allclose(p.sum(axis=1), 1.0, rtol=1e-5)
@@ -45,7 +59,7 @@ def test_multiclass_ova(multiclass_example):
     params = {"objective": "multiclassova", "num_class": 5,
               "metric": "multi_error", "verbose": -1,
               "min_data_in_leaf": 10}
-    _, res = _train(params, (X, y, Xt, yt), rounds=15)
+    _, res = _train(params, (X, y, Xt, yt), rounds=6)
     assert res["multi_error"][-1] < 0.65
 
 
@@ -54,8 +68,8 @@ def test_lambdarank(rank_example):
     params = {"objective": "lambdarank", "metric": "ndcg",
               "ndcg_eval_at": [1, 3, 5], "verbose": -1,
               "min_data_in_leaf": 20}
-    bst, res = _train(params, (X, y, Xt, yt, q, qt), rounds=15)
-    assert res["ndcg@3"][-1] > 0.55
+    bst, res = _train(params, (X, y, Xt, yt, q, qt), rounds=8)
+    assert res["ndcg@3"][-1] > 0.52
     # trajectory improves over training
     assert res["ndcg@3"][-1] > res["ndcg@3"][0] - 1e-9
 
@@ -65,8 +79,9 @@ def test_dart(binary_example):
     params = {"objective": "binary", "metric": "binary_logloss",
               "boosting_type": "dart", "drop_rate": 0.3, "verbose": -1,
               "min_data_in_leaf": 10}
-    _, res = _train(params, (X, y, Xt, yt), rounds=20)
-    assert res["binary_logloss"][-1] < 0.63
+    _, res = _train(params, (X, y, Xt, yt), rounds=10)
+    assert res["binary_logloss"][-1] < 0.66
+    assert res["binary_logloss"][-1] < res["binary_logloss"][0] - 0.01
 
 
 def test_goss(binary_example):
@@ -74,8 +89,8 @@ def test_goss(binary_example):
     params = {"objective": "binary", "metric": "binary_logloss",
               "boosting_type": "goss", "top_rate": 0.3, "other_rate": 0.2,
               "verbose": -1, "min_data_in_leaf": 10}
-    _, res = _train(params, (X, y, Xt, yt), rounds=20)
-    assert res["binary_logloss"][-1] < 0.57
+    _, res = _train(params, (X, y, Xt, yt), rounds=10)
+    assert res["binary_logloss"][-1] < 0.61
 
 
 def test_early_stopping(binary_example):

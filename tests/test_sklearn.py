@@ -31,7 +31,7 @@ def test_classifier(binary_example):
 
 def test_classifier_multiclass(multiclass_example):
     X, y, Xt, yt = multiclass_example
-    clf = LGBMClassifier(n_estimators=15, min_child_samples=10)
+    clf = LGBMClassifier(n_estimators=8, min_child_samples=10)
     clf.fit(X, y, verbose=False)
     proba = clf.predict_proba(Xt)
     assert proba.shape == (len(yt), 5)

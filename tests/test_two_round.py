@@ -51,17 +51,17 @@ def test_sampled_reservoir_statistics(tmp_path):
     """With a sample smaller than the file, the reservoir still produces
     near-identical bin boundaries (same data distribution)."""
     rng = np.random.RandomState(2)
-    X = rng.randn(60_000, 4)
+    X = rng.randn(24_000, 4)
     y = (X[:, 0] > 0).astype(float)
     f = str(tmp_path / "big.tsv")
     np.savetxt(f, np.column_stack([y, X]), delimiter="\t", fmt="%.6g")
-    d1 = Dataset.from_file(f, Config(bin_construct_sample_cnt=20_000))
-    d2 = Dataset.from_file(f, Config(bin_construct_sample_cnt=20_000,
+    d1 = Dataset.from_file(f, Config(bin_construct_sample_cnt=8_000))
+    d2 = Dataset.from_file(f, Config(bin_construct_sample_cnt=8_000,
                                      use_two_round_loading=True))
     assert d1.num_data == d2.num_data
     # different 20k samples of the same distribution: order-statistic
     # jitter moves boundaries by ~1 bin width at 255 bins (rank SE
-    # ~sqrt(20000)/255), so exact ids differ freely but rarely by more
+    # ~sqrt(8000)/255), so exact ids differ freely but rarely by more
     # than a couple of bins
     diff = np.abs(d1.bins.astype(np.int32) - d2.bins.astype(np.int32))
     assert (diff <= 3).mean() > 0.99, (diff <= 3).mean()
