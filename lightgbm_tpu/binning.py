@@ -130,6 +130,39 @@ def _numerical_bins(vals: np.ndarray, counts: np.ndarray, total_sample_cnt: int,
     if rest_bin_cnt > 0:
         mean_bin_size = rest_sample_cnt / rest_bin_cnt
 
+    if not is_big.any():
+        # Fast path for the dominant continuous-data case (no value holds
+        # >= a mean bin's worth of samples): the greedy scan reduces to
+        # "emit a boundary where the count cumsum crosses the adaptive
+        # threshold", which is one searchsorted per EMITTED BIN (<= 255)
+        # instead of one Python iteration per DISTINCT VALUE (up to the
+        # full sample count).  Emission-for-emission identical to the
+        # general loop below: cur >= mean_bin_size with
+        # mean = remaining_samples / remaining_bins recomputed per bin.
+        # float64 cumsum: exact for any realistic count (< 2^53) and avoids
+        # an int->float array promotion copy inside every searchsorted
+        cumsum = np.cumsum(counts[: n_distinct - 1]).astype(np.float64)
+        n_scan = cumsum.size
+        upper_i: List[int] = []
+        cum_prev = 0
+        rest_bins = max_bin
+        while len(upper_i) < max_bin - 1 and rest_bins > 0:
+            mean = (total_sample_cnt - cum_prev) / rest_bins
+            i = int(cumsum.searchsorted(cum_prev + mean, side="left"))
+            if i >= n_scan:
+                break
+            upper_i.append(i)
+            cnt_in_bin.append(int(cumsum[i]) - cum_prev)
+            cum_prev = int(cumsum[i])
+            rest_bins -= 1
+        cnt_in_bin.append(total_sample_cnt - cum_prev)
+        nb = len(upper_i) + 1
+        ub = np.empty(nb)
+        for k in range(nb - 1):
+            ub[k] = (vals[upper_i[k]] + vals[upper_i[k] + 1]) / 2.0
+        ub[nb - 1] = np.inf
+        return ub, cnt_in_bin
+
     upper: List[float] = []
     lower: List[float] = [float(vals[0])]
     cur = 0

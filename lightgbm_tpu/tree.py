@@ -127,7 +127,10 @@ class Tree:
             v = X[active, f]
             thr = self.threshold[node[active]]
             dec = self.decision_type[node[active]]
-            go_left = np.where(dec == 0, v <= thr, v.astype(np.int64) == thr.astype(np.int64))
+            finite = np.isfinite(v)
+            vi = np.where(finite, v, -1.0).astype(np.int64)
+            go_left = np.where(dec == 0, v <= thr,
+                               finite & (vi == thr.astype(np.int64)))
             nxt = np.where(go_left, self.left_child[node[active]],
                            self.right_child[node[active]])
             node[active] = nxt

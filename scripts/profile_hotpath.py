@@ -1,0 +1,93 @@
+"""Microbenchmark the training hot path on the live chip.
+
+Times each device op of the rounds learner in isolation at the
+north-star shape, then one full Booster.update, so the gap between
+"sum of parts" and the whole iteration (host orchestration, fusion
+losses) is visible.  Usage:
+
+    python scripts/profile_hotpath.py [N] [F] [max_bin]
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 10_500_000
+F = int(sys.argv[2]) if len(sys.argv) > 2 else 28
+MB = int(sys.argv[3]) if len(sys.argv) > 3 else 255
+K = 42
+DT = "bfloat16"
+
+
+def timeit(fn, *args, n=5, warmup=2):
+    import jax
+    for _ in range(warmup):
+        r = fn(*args)
+    jax.block_until_ready(r)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = fn(*args)
+    jax.block_until_ready(r)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.histogram import hist_multileaf_masked
+    from lightgbm_tpu.ops.lookup import select_bin_by_feature, table_lookup
+
+    from lightgbm_tpu.learner.common import padded_bin_count
+    B = padded_bin_count(MB + 1)
+    backend = "pallas" if jax.default_backend() == "tpu" else "xla"
+    print(f"backend={jax.default_backend()} N={N} F={F} B={B} K={K}")
+    rng = np.random.RandomState(0)
+    bins = jnp.asarray(rng.randint(0, MB, size=(F, N), dtype=np.int32))
+    lid = jnp.asarray(rng.randint(0, 255, size=N, dtype=np.int32))
+    gh8 = jnp.asarray(rng.randn(8, N).astype(np.float32))
+    sl = jnp.asarray(np.arange(K, dtype=np.int32))
+
+    t = timeit(lambda: hist_multileaf_masked(
+        bins, lid, gh8, sl, num_bins_padded=B, backend=backend,
+        input_dtype=DT))
+    mxu = N * F * (8 * ((3 * K + 7) // 8)) * B * 2 / 1e12
+    print(f"hist_multileaf_masked K={K}: {t*1e3:.1f} ms  "
+          f"({mxu / t:.0f} TFLOP/s effective)")
+
+    t1 = timeit(lambda: hist_multileaf_masked(
+        bins, lid, gh8, jnp.asarray(np.arange(1, dtype=np.int32)),
+        num_bins_padded=B, backend=backend, input_dtype=DT))
+    print(f"hist_multileaf_masked K=1 (root): {t1*1e3:.1f} ms")
+
+    t2 = timeit(lambda: select_bin_by_feature(bins, lid % F))
+    print(f"select_bin_by_feature: {t2*1e3:.1f} ms")
+
+    tbl = jnp.asarray(rng.randn(4, 256).astype(np.float32))
+    t3 = timeit(lambda: table_lookup(tbl, lid, num_slots=256))
+    print(f"table_lookup [4,256]: {t3*1e3:.1f} ms")
+
+    # full iteration for the same shape
+    import lightgbm_tpu as lgb
+    sys.path.insert(0, ROOT)
+    import bench
+    X, y = bench.synth_higgs(N, f=F)
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 255,
+              "learning_rate": 0.1, "max_bin": MB, "min_data_in_leaf": 1,
+              "min_sum_hessian_in_leaf": 100.0, "histogram_dtype": DT}
+    ds = lgb.Dataset(X, y)
+    bst = lgb.Booster(params, ds)
+    for _ in range(3):
+        bst.update()
+    t0 = time.perf_counter()
+    for _ in range(10):
+        bst.update()
+    jax.block_until_ready(bst._gbdt.train_score.score)
+    print(f"full update(): {(time.perf_counter()-t0)/10*1e3:.1f} ms/iter")
+
+
+if __name__ == "__main__":
+    main()

@@ -58,8 +58,7 @@ def run_case(name, X, y, max_bin):
     t0 = time.perf_counter()
     train = lgb.Dataset(X, y).construct(params)
     t_bin = time.perf_counter() - t0
-    bst = lgb.Booster(params, train._inner
-                      if hasattr(train, "_inner") else train)
+    bst = lgb.Booster(params, train)
     for _ in range(WARMUP):
         bst.update()
     t0 = time.perf_counter()

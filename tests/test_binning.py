@@ -145,3 +145,82 @@ def test_valid_set_uses_train_binning(binary_example):
     assert valid.max_num_bin == train.max_num_bin
     for mt, mv in zip(train.mappers, valid.mappers):
         assert mt.num_bin == mv.num_bin
+
+
+def test_numerical_bins_fast_path_matches_general_loop():
+    """The no-big-count searchsorted fast path in _numerical_bins must be
+    emission-for-emission identical to the general greedy scan (reference
+    bin.cpp:109-186 semantics).  The oracle below is the general loop."""
+    from lightgbm_tpu.binning import _numerical_bins, _distinct_with_zero
+
+    def oracle(vals, counts, total_sample_cnt, max_bin, min_data_in_bin):
+        n_distinct = vals.size
+        cnt_in_bin = []
+        if min_data_in_bin > 0:
+            max_bin = max(1, min(max_bin,
+                                 total_sample_cnt // min_data_in_bin))
+        mean_bin_size = total_sample_cnt / max_bin
+        zero_idx = np.flatnonzero(vals == 0.0)
+        zero_cnt = int(counts[zero_idx[0]]) if zero_idx.size else 0
+        if zero_cnt > mean_bin_size:
+            non_zero_cnt = total_sample_cnt - zero_cnt
+            max_bin = min(max_bin,
+                          1 + non_zero_cnt // max(min_data_in_bin, 1))
+        max_bin = max(int(max_bin), 1)
+        is_big = counts >= mean_bin_size
+        rest_bin_cnt = max_bin - int(is_big.sum())
+        rest_sample_cnt = total_sample_cnt - int(counts[is_big].sum())
+        if rest_bin_cnt > 0:
+            mean_bin_size = rest_sample_cnt / rest_bin_cnt
+        upper, lower, cur, bin_cnt = [], [float(vals[0])], 0, 0
+        for i in range(n_distinct - 1):
+            if not is_big[i]:
+                rest_sample_cnt -= int(counts[i])
+            cur += int(counts[i])
+            if (is_big[i] or cur >= mean_bin_size or
+                    (is_big[i + 1] and cur >= max(1.0,
+                                                  mean_bin_size * 0.5))):
+                upper.append(float(vals[i]))
+                cnt_in_bin.append(cur)
+                bin_cnt += 1
+                lower.append(float(vals[i + 1]))
+                if bin_cnt >= max_bin - 1:
+                    break
+                cur = 0
+                if not is_big[i]:
+                    rest_bin_cnt -= 1
+                    if rest_bin_cnt > 0:
+                        mean_bin_size = rest_sample_cnt / rest_bin_cnt
+        cnt_in_bin.append(int(total_sample_cnt - sum(cnt_in_bin)))
+        bin_cnt += 1
+        ub = np.empty(bin_cnt)
+        for i in range(bin_cnt - 1):
+            ub[i] = (upper[i] + lower[i + 1]) / 2.0
+        ub[bin_cnt - 1] = np.inf
+        return ub, cnt_in_bin
+
+    rng = np.random.RandomState(0)
+    checked = 0
+    for trial in range(120):
+        kind = trial % 4
+        n = rng.randint(300, 4000)
+        if kind == 0:
+            x = rng.randn(n)                    # continuous, all distinct
+        elif kind == 1:
+            x = rng.randn(n).round(2)           # many duplicates
+        elif kind == 2:
+            x = np.abs(rng.randn(n))
+            x[rng.rand(n) < 0.3] = 0.0          # sparse-ish
+        else:
+            x = rng.exponential(1.0, n).round(1)  # skewed duplicates
+        vals, counts = _distinct_with_zero(x[x != 0], n)
+        mb = int(rng.choice([15, 63, 255]))
+        mdib = int(rng.choice([1, 3, 10]))
+        if vals.size <= mb:
+            continue
+        ub_new, cib_new = _numerical_bins(vals, counts, n, mb, mdib)
+        ub_old, cib_old = oracle(vals, counts, n, mb, mdib)
+        np.testing.assert_array_equal(ub_new, ub_old)
+        assert list(cib_new) == list(cib_old)
+        checked += 1
+    assert checked > 40

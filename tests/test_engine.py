@@ -74,6 +74,18 @@ def test_lambdarank(rank_example):
     assert res["ndcg@3"][-1] > res["ndcg@3"][0] - 1e-9
 
 
+@pytest.mark.slow
+def test_lambdarank_parity(rank_example):
+    """Full-length accuracy guard (original 15-round threshold; the
+    default tier keeps the shorter trajectory check above)."""
+    X, y, q, Xt, yt, qt = rank_example
+    params = {"objective": "lambdarank", "metric": "ndcg",
+              "ndcg_eval_at": [1, 3, 5], "verbose": -1,
+              "min_data_in_leaf": 20}
+    _, res = _train(params, (X, y, Xt, yt, q, qt), rounds=15)
+    assert res["ndcg@3"][-1] > 0.55
+
+
 def test_dart(binary_example):
     X, y, Xt, yt = binary_example
     params = {"objective": "binary", "metric": "binary_logloss",
@@ -91,6 +103,23 @@ def test_goss(binary_example):
               "verbose": -1, "min_data_in_leaf": 10}
     _, res = _train(params, (X, y, Xt, yt), rounds=10)
     assert res["binary_logloss"][-1] < 0.61
+
+
+@pytest.mark.slow
+def test_dart_goss_parity(binary_example):
+    """Full-length accuracy guards for DART and GOSS (original 20-round
+    thresholds; the default tier keeps the shorter trajectory checks)."""
+    X, y, Xt, yt = binary_example
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "boosting_type": "dart", "drop_rate": 0.3, "verbose": -1,
+              "min_data_in_leaf": 10}
+    _, res = _train(params, (X, y, Xt, yt), rounds=20)
+    assert res["binary_logloss"][-1] < 0.63
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "boosting_type": "goss", "top_rate": 0.3, "other_rate": 0.2,
+              "verbose": -1, "min_data_in_leaf": 10}
+    _, res = _train(params, (X, y, Xt, yt), rounds=20)
+    assert res["binary_logloss"][-1] < 0.57
 
 
 def test_early_stopping(binary_example):

@@ -59,7 +59,7 @@ def test_sampled_reservoir_statistics(tmp_path):
     d2 = Dataset.from_file(f, Config(bin_construct_sample_cnt=8_000,
                                      use_two_round_loading=True))
     assert d1.num_data == d2.num_data
-    # different 20k samples of the same distribution: order-statistic
+    # different 8k samples of the same distribution: order-statistic
     # jitter moves boundaries by ~1 bin width at 255 bins (rank SE
     # ~sqrt(8000)/255), so exact ids differ freely but rarely by more
     # than a couple of bins
