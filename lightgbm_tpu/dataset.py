@@ -177,6 +177,73 @@ def _parse_categorical_column(spec: str, feature_names: Optional[List[str]],
     return [i for i in out if 0 <= i < num_features]
 
 
+def _resolve_column_selectors(cfg: Config, names: Optional[List[str]],
+                              label_idx: int, n_xcols: int
+                              ) -> Tuple[Optional[int], Optional[int],
+                                         List[int]]:
+    """Resolve `weight_column` / `group_column` / `ignore_column` to
+    X-space column indices (file columns with the label removed),
+    validating ranges and `name:` selectors (dataset_loader.cpp:22-157).
+    Returns (weight_xcol, group_xcol, drop_xcols) — weight/group columns
+    are included in drop_xcols."""
+
+    def _resolve(spec: str, what: str) -> Optional[int]:
+        spec = spec.strip()
+        if not spec:
+            return None
+        if spec.startswith("name:"):
+            if not names:
+                raise ValueError(
+                    f"{what}={spec} needs has_header=true with a header")
+            nm = spec[5:].strip()
+            if nm not in names:
+                raise ValueError(f"{what}: no column named {nm!r}")
+            return names.index(nm)
+        return int(spec)
+
+    def _xcol(c: int, what: str) -> int:
+        if c == label_idx:
+            raise ValueError(f"{what} column {c} is the label column")
+        if not 0 <= c <= n_xcols:
+            raise ValueError(f"{what} column {c} out of range")
+        return c - 1 if c > label_idx else c
+
+    drop: List[int] = []
+    wi = _resolve(cfg.weight_column, "weight_column")
+    xw = None
+    if wi is not None:
+        xw = _xcol(wi, "weight_column")
+        drop.append(xw)
+    gi = _resolve(cfg.group_column, "group_column")
+    xg = None
+    if gi is not None:
+        xg = _xcol(gi, "group_column")
+        drop.append(xg)
+    ign = cfg.ignore_column.strip()
+    if ign.startswith("name:"):
+        # `name:` prefixes the WHOLE comma-separated list
+        # (dataset_loader.cpp ignore-column parsing)
+        for nm in ign[5:].split(","):
+            ci = _resolve(f"name:{nm.strip()}", "ignore_column")
+            if ci is not None:
+                drop.append(_xcol(ci, "ignore_column"))
+    elif ign:
+        for tok in ign.replace(",", " ").split():
+            drop.append(_xcol(int(tok), "ignore_column"))
+    return xw, xg, drop
+
+
+def _query_boundaries_from_ids(qid: np.ndarray) -> np.ndarray:
+    """Per-row query ids -> boundaries (metadata.cpp group-column
+    handling): rows of one query must be contiguous."""
+    change = np.nonzero(qid[1:] != qid[:-1])[0] + 1
+    starts = np.concatenate([[0], change])
+    if len(np.unique(qid)) != len(starts):
+        raise ValueError("group_column: rows of the same query must be "
+                         "contiguous in the data file")
+    return np.concatenate([starts, [len(qid)]]).astype(np.int32)
+
+
 def load_file_two_round(path: str, cfg: Config,
                         reference: Optional["Dataset"] = None,
                         chunk_rows: int = 262_144) -> "Dataset":
@@ -200,10 +267,6 @@ def load_file_two_round(path: str, cfg: Config,
         raise NotImplementedError("label by name requires header support")
     elif cfg.label_column:
         label_idx = int(cfg.label_column)
-    if cfg.weight_column or cfg.group_column or cfg.ignore_column:
-        raise NotImplementedError(
-            "column selectors with two-round loading are not supported "
-            "yet; drop use_two_round_loading or use side files")
 
     with open(path, "r") as f:
         first = f.readline()
@@ -222,12 +285,18 @@ def load_file_two_round(path: str, cfg: Config,
                            dtype=np.float64)
 
     # ---- pass 1: count rows, reservoir-sample, collect label ------------
+    # (and the weight/group selector columns in full, like the one-shot
+    # path: the reference streams selector columns during its first pass,
+    # dataset_loader.cpp:159-216 + :22-157)
     S = int(cfg.bin_construct_sample_cnt)
     rng = np.random.RandomState(cfg.data_random_seed)
     sample: Optional[np.ndarray] = None     # [S, F] reservoir
     filled = 0
     labels: List[np.ndarray] = []
+    wvals: List[np.ndarray] = []
+    gvals: List[np.ndarray] = []
     names: Optional[List[str]] = None
+    sel = None                               # (weight_x, group_x, keep)
     n_seen = 0
     for ch in chunks():
         arr = ch.to_numpy(dtype=np.float64)
@@ -235,6 +304,19 @@ def load_file_two_round(path: str, cfg: Config,
             names = [str(c) for c in ch.columns]
         labels.append(arr[:, label_idx].copy())
         X = np.delete(arr, label_idx, axis=1)
+        if sel is None:
+            xw, xg, drop = _resolve_column_selectors(
+                cfg, names, label_idx, X.shape[1])
+            keep = ([c for c in range(X.shape[1]) if c not in set(drop)]
+                    if drop else None)
+            sel = (xw, xg, keep)
+        xw, xg, keep = sel
+        if xw is not None:
+            wvals.append(X[:, xw].copy())
+        if xg is not None:
+            gvals.append(X[:, xg].copy())
+        if keep is not None:
+            X = X[:, keep]
         if sample is None:
             sample = np.empty((S, X.shape[1]), np.float64)
         take = min(S - filled, len(X))       # fill phase
@@ -254,10 +336,24 @@ def load_file_two_round(path: str, cfg: Config,
     sample = sample[:filled]
     md = Metadata.load_side_files(path, n)
     md.label = np.asarray(y, np.float32)
+    xw, xg, keep = sel
+    if xw is not None:
+        if md.weights is not None:
+            from . import log
+            log.warning("weight_column overrides the .weight side file")
+        md.weights = np.concatenate(wvals).astype(np.float32)
+    if xg is not None:
+        if md.query_boundaries is not None:
+            from . import log
+            log.warning("group_column overrides the .query side file")
+        md.query_boundaries = _query_boundaries_from_ids(
+            np.concatenate(gvals))
 
     x_names = None
     if names:
         x_names = [nm for c, nm in enumerate(names) if c != label_idx]
+        if keep is not None:
+            x_names = [x_names[c] for c in keep]
 
     # ---- mappers from the sample ----------------------------------------
     cats = _parse_categorical_column(cfg.categorical_column, x_names,
@@ -281,6 +377,8 @@ def load_file_two_round(path: str, cfg: Config,
     for ch in chunks():
         arr = ch.to_numpy(dtype=np.float64)
         X = np.delete(arr, label_idx, axis=1)
+        if keep is not None:
+            X = X[:, keep]
         ds._bin_rows_into(X, row)
         row += len(X)
     ds.metadata = md
@@ -577,66 +675,18 @@ class Dataset:
         # ---- in-file column selectors (dataset_loader.cpp:22-157) ----------
         # Indices count the FILE's columns (label included), the reference
         # CSV/TSV convention; `name:` selectors need has_header.
-        def _resolve(spec: str, what: str) -> Optional[int]:
-            spec = spec.strip()
-            if not spec:
-                return None
-            if spec.startswith("name:"):
-                if not names:
-                    raise ValueError(
-                        f"{what}={spec} needs has_header=true with a header")
-                nm = spec[5:].strip()
-                if nm not in names:
-                    raise ValueError(f"{what}: no column named {nm!r}")
-                return names.index(nm)
-            return int(spec)
-
-        def _xcol(c: int, what: str) -> int:
-            """file column index -> X column index (label removed)."""
-            if c == label_idx:
-                raise ValueError(f"{what} column {c} is the label column")
-            if not 0 <= c <= X.shape[1]:
-                raise ValueError(f"{what} column {c} out of range")
-            return c - 1 if c > label_idx else c
-
-        drop: List[int] = []
-        wi = _resolve(cfg.weight_column, "weight_column")
-        if wi is not None:
-            xw = _xcol(wi, "weight_column")
+        xw, xg, drop = _resolve_column_selectors(cfg, names, label_idx,
+                                                 X.shape[1])
+        if xw is not None:
             if md.weights is not None:
                 from . import log
                 log.warning("weight_column overrides the .weight side file")
             md.weights = X[:, xw].astype(np.float32)
-            drop.append(xw)
-        gi = _resolve(cfg.group_column, "group_column")
-        if gi is not None:
-            xg = _xcol(gi, "group_column")
-            qid = X[:, xg]
-            # per-row query ids -> boundaries (metadata.cpp group column
-            # handling): rows of one query must be contiguous
-            change = np.nonzero(qid[1:] != qid[:-1])[0] + 1
-            starts = np.concatenate([[0], change])
-            if len(np.unique(qid)) != len(starts):
-                raise ValueError(
-                    "group_column: rows of the same query must be "
-                    "contiguous in the data file")
+        if xg is not None:
             if md.query_boundaries is not None:
                 from . import log
                 log.warning("group_column overrides the .query side file")
-            md.query_boundaries = np.concatenate(
-                [starts, [len(qid)]]).astype(np.int32)
-            drop.append(xg)
-        ign = cfg.ignore_column.strip()
-        if ign.startswith("name:"):
-            # `name:` prefixes the WHOLE comma-separated list
-            # (dataset_loader.cpp ignore-column parsing)
-            for nm in ign[5:].split(","):
-                ci = _resolve(f"name:{nm.strip()}", "ignore_column")
-                if ci is not None:
-                    drop.append(_xcol(ci, "ignore_column"))
-        elif ign:
-            for tok in ign.replace(",", " ").split():
-                drop.append(_xcol(int(tok), "ignore_column"))
+            md.query_boundaries = _query_boundaries_from_ids(X[:, xg])
 
         x_names = None
         if names:

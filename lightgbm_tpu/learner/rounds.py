@@ -48,7 +48,15 @@ from ..ops.split import best_split, leaf_output
 from ..tree import Tree
 
 NEG_INF = -jnp.inf
-LEAVES_PER_BATCH = 42   # 3·42 = 126 ≤ 128 matmul rows per hist pass
+# Leaves histogrammed per multi-leaf pass.  3·K is the M dimension of the
+# hist matmul; M > 128 tiles onto the MXU, and a LARGER K means FEWER
+# full-row passes per round — the per-pass costs (one-hot construction on
+# the VPU, bin reads from HBM) amortize over more leaves.  84 (M=256)
+# measured fastest on v5e at the north-star shape; overridable for
+# experiments via LGBT_LEAVES_PER_BATCH.
+import os as _os
+LEAVES_PER_BATCH = max(1, int(_os.environ.get("LGBT_LEAVES_PER_BATCH",
+                                              "84") or 84))
 
 
 def _psum(x, axis):

@@ -19,7 +19,7 @@ sys.path.insert(0, ROOT)
 N = int(sys.argv[1]) if len(sys.argv) > 1 else 10_500_000
 F = int(sys.argv[2]) if len(sys.argv) > 2 else 28
 MB = int(sys.argv[3]) if len(sys.argv) > 3 else 255
-K = 42
+from lightgbm_tpu.learner.rounds import LEAVES_PER_BATCH as K  # noqa: E402
 DT = "bfloat16"
 
 
@@ -72,7 +72,6 @@ def main():
 
     # full iteration for the same shape
     import lightgbm_tpu as lgb
-    sys.path.insert(0, ROOT)
     import bench
     X, y = bench.synth_higgs(N, f=F)
     params = {"objective": "binary", "verbose": -1, "num_leaves": 255,

@@ -101,3 +101,39 @@ def test_lambdarank_group_column_end_to_end(rank_files):
         return gbdt.save_model_to_string()
 
     assert train(f_sel, group_column="1", weight_column="2") == train(f_side)
+
+
+@pytest.mark.quick
+def test_selectors_with_two_round_loading(rank_files):
+    """Streaming two-round ingestion honors the same selectors and
+    produces a bit-identical Dataset to the one-shot selector path (the
+    full file fits one chunk here; chunking itself is covered by
+    test_two_round.py)."""
+    f_sel, _ = rank_files
+    cfg = dict(group_column="1", weight_column="2", ignore_column="4")
+    ds1 = Dataset.from_file(f_sel, Config(**cfg))
+    ds2 = Dataset.from_file(f_sel, Config(use_two_round_loading=True,
+                                          **cfg))
+    assert ds1.num_features == ds2.num_features == 4
+    assert np.array_equal(ds1.bins, ds2.bins)
+    assert np.array_equal(ds1.metadata.query_boundaries,
+                          ds2.metadata.query_boundaries)
+    assert np.allclose(ds1.metadata.weights, ds2.metadata.weights)
+
+
+@pytest.mark.quick
+def test_selectors_two_round_chunked(tmp_path):
+    """Selector columns collected correctly across MULTIPLE chunks."""
+    from lightgbm_tpu.dataset import load_file_two_round
+    rng = np.random.RandomState(3)
+    n = 5000
+    X = rng.randn(n, 3)
+    y = (X[:, 0] > 0).astype(float)
+    w = rng.rand(n) + 0.1
+    f = str(tmp_path / "w.tsv")
+    np.savetxt(f, np.column_stack([y, w, X]), delimiter="\t", fmt="%.10g")
+    ds = load_file_two_round(f, Config(weight_column="1"), chunk_rows=700)
+    assert ds.num_features == 3
+    assert np.allclose(ds.metadata.weights, w.astype(np.float32))
+    ds1 = Dataset.from_file(f, Config(weight_column="1"))
+    assert np.array_equal(ds1.bins, ds.bins)

@@ -95,12 +95,17 @@ def test_side_files_still_loaded(tmp_path):
 
 
 @pytest.mark.quick
-def test_selectors_rejected(tmp_path):
+def test_selector_errors_still_raise(tmp_path):
+    """Selector validation (bad index / name: without header) raises in
+    two-round mode exactly like the one-shot path."""
     rng = np.random.RandomState(4)
     X = rng.randn(100, 3)
     f = str(tmp_path / "d.tsv")
     np.savetxt(f, np.column_stack([rng.rand(100), X]), delimiter="\t",
                fmt="%.8g")
-    with pytest.raises(NotImplementedError):
+    with pytest.raises(ValueError):
         Dataset.from_file(f, Config(use_two_round_loading=True,
-                                    weight_column="1"))
+                                    weight_column="0"))   # label column
+    with pytest.raises(ValueError):
+        Dataset.from_file(f, Config(use_two_round_loading=True,
+                                    group_column="name:q"))  # no header
