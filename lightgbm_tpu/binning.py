@@ -46,17 +46,33 @@ class BinMapper:
     def value_to_bin(self, values: np.ndarray) -> np.ndarray:
         """Vectorized value->bin (reference bin.h:418-440).  NaN maps to
         value 0 (v2.0-era missing handling; searchsorted would otherwise
-        return an out-of-range bin)."""
+        return an out-of-range bin).  Unseen categories map to bin 0."""
         values = np.asarray(values, dtype=np.float64)
         values = np.where(np.isnan(values), 0.0, values)
         if self.bin_type == NUMERICAL:
             return np.searchsorted(self.bin_upper_bound, values, side="left").astype(
                 np.int32)
-        out = np.zeros(values.shape, dtype=np.int32)
+        # categorical: one searchsorted over the sorted category table
+        # instead of a Python loop per category (Expo-scale data has
+        # hundreds of categories x millions of rows)
+        cs = getattr(self, "_cat_sorted", None)
+        # rebuild when the category list was replaced (identity) OR
+        # mutated in place (length) since the table was built
+        if (cs is None or cs[2] is not self.bin_2_categorical
+                or len(cs[0]) != len(self.bin_2_categorical)):
+            cats = np.asarray(self.bin_2_categorical, np.int64)
+            order = np.argsort(cats)
+            cs = (cats[order], np.arange(len(cats), dtype=np.int32)[order],
+                  self.bin_2_categorical)
+            self._cat_sorted = cs
+        cats_sorted, bins_sorted = cs[0], cs[1]
         iv = values.astype(np.int64)
-        for cat, b in self.categorical_2_bin.items():
-            out[iv == cat] = b
-        return out
+        pos = np.clip(np.searchsorted(cats_sorted, iv), 0,
+                      max(len(cats_sorted) - 1, 0))
+        if len(cats_sorted) == 0:
+            return np.zeros(values.shape, np.int32)
+        return np.where(cats_sorted[pos] == iv, bins_sorted[pos],
+                        np.int32(0)).astype(np.int32)
 
     def bin_to_value(self, b: int) -> float:
         """Real-valued threshold stored in the model text for bin `b`."""

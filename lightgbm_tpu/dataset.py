@@ -303,20 +303,26 @@ def load_file_two_round(path: str, cfg: Config,
         if names is None and cfg.has_header:
             names = [str(c) for c in ch.columns]
         labels.append(arr[:, label_idx].copy())
-        X = np.delete(arr, label_idx, axis=1)
         if sel is None:
-            xw, xg, drop = _resolve_column_selectors(
-                cfg, names, label_idx, X.shape[1])
-            keep = ([c for c in range(X.shape[1]) if c not in set(drop)]
+            n_x = arr.shape[1] - 1
+            xw, xg, drop = _resolve_column_selectors(cfg, names, label_idx,
+                                                     n_x)
+            # ONE fused column take per chunk: file columns minus the
+            # label minus any selector/ignored columns (X-space -> file-
+            # space is +1 past the label column)
+            def _fcol(c):
+                return c + 1 if c >= label_idx else c
+            dropped = set(drop)
+            use_cols = [_fcol(c) for c in range(n_x) if c not in dropped]
+            keep = ([c for c in range(n_x) if c not in dropped]
                     if drop else None)
-            sel = (xw, xg, keep)
-        xw, xg, keep = sel
+            sel = (xw, xg, keep, use_cols)
+        xw, xg, keep, use_cols = sel
         if xw is not None:
-            wvals.append(X[:, xw].copy())
+            wvals.append(arr[:, xw + 1 if xw >= label_idx else xw].copy())
         if xg is not None:
-            gvals.append(X[:, xg].copy())
-        if keep is not None:
-            X = X[:, keep]
+            gvals.append(arr[:, xg + 1 if xg >= label_idx else xg].copy())
+        X = arr[:, use_cols]
         if sample is None:
             sample = np.empty((S, X.shape[1]), np.float64)
         take = min(S - filled, len(X))       # fill phase
@@ -336,7 +342,7 @@ def load_file_two_round(path: str, cfg: Config,
     sample = sample[:filled]
     md = Metadata.load_side_files(path, n)
     md.label = np.asarray(y, np.float32)
-    xw, xg, keep = sel
+    xw, xg, keep, use_cols = sel
     if xw is not None:
         if md.weights is not None:
             from . import log
@@ -376,11 +382,8 @@ def load_file_two_round(path: str, cfg: Config,
     row = 0
     for ch in chunks():
         arr = ch.to_numpy(dtype=np.float64)
-        X = np.delete(arr, label_idx, axis=1)
-        if keep is not None:
-            X = X[:, keep]
-        ds._bin_rows_into(X, row)
-        row += len(X)
+        ds._bin_rows_into(arr[:, use_cols], row)
+        row += len(arr)
     ds.metadata = md
     return ds
 

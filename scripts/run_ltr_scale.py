@@ -1,0 +1,103 @@
+"""LTR at MS-LTR scale on the live chip (round-2 verdict weak #8: prove
+eval-enabled lambdarank training scales past ~31k queries).
+
+Synthetic MS-LTR-shaped workload: 2,270,296 rows x 137 features,
+~30.7k queries (74 rows/query avg), graded 0-4 relevance, lambdarank
+objective, NDCG@{1,3,5} tracked on a held-out 340k-row query set.
+Measures s/iter with NO eval vs eval EVERY iteration — the device
+ndcg_at_k kernel (ops/eval.py) keeps scores resident, so the delta is
+the claim under test.
+
+Writes ltr_scale_measured.json at the repo root.
+Env: LTR_ROWS / LTR_ITERS to shrink for smoke runs.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+ROWS = int(os.environ.get("LTR_ROWS", 2_270_296))
+TEST_ROWS = int(os.environ.get("LTR_TEST_ROWS", 340_000))
+ITERS = int(os.environ.get("LTR_ITERS", 30))
+WARMUP = 3
+
+
+def synth_msltr(n, f=137, seed=0, avg_q=74):
+    rng = np.random.RandomState(seed)
+    sizes = []
+    tot = 0
+    while tot < n:
+        s = int(rng.randint(avg_q // 2, avg_q * 2))
+        sizes.append(min(s, n - tot))
+        tot += sizes[-1]
+    sizes = np.asarray(sizes, np.int64)
+    X = rng.randn(n, f).astype(np.float32)
+    beta = np.random.RandomState(99).randn(f) / np.sqrt(f)
+    rel = X @ beta + 0.8 * rng.randn(n)
+    y = np.clip(np.digitize(rel, [-1.0, 0.0, 1.0, 1.8]), 0, 4).astype(
+        np.float64)
+    return X.astype(np.float64), y, sizes
+
+
+def main():
+    from bench import default_backend_alive, force_cpu_backend
+    if os.environ.get("JAX_PLATFORMS") == "cpu" or not default_backend_alive():
+        force_cpu_backend()      # wedged remote-TPU tunnel or explicit CPU
+    import jax
+    import lightgbm_tpu as lgb
+
+    params = {"objective": "lambdarank", "metric": "ndcg",
+              "ndcg_eval_at": [1, 3, 5], "num_leaves": 255, "max_bin": 255,
+              "learning_rate": 0.1, "min_data_in_leaf": 1,
+              "min_sum_hessian_in_leaf": 100.0, "verbose": -1,
+              "histogram_dtype": "bfloat16"}
+    X, y, q = synth_msltr(ROWS)
+    Xt, yt, qt = synth_msltr(TEST_ROWS, seed=5)
+    t0 = time.perf_counter()
+    train = lgb.Dataset(X, y, group=q).construct(params)
+    valid = lgb.Dataset(Xt, yt, group=qt, reference=train).construct(params)
+    t_bin = time.perf_counter() - t0
+
+    def run(with_eval):
+        bst = lgb.Booster(params, train)
+        if with_eval:
+            bst._gbdt.add_valid(valid._inner, "test")
+        ndcg = None
+        for _ in range(WARMUP):
+            bst.update()
+            if with_eval:
+                ndcg = bst._gbdt.eval_valid()
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            bst.update()
+            if with_eval:
+                ndcg = bst._gbdt.eval_valid()
+        jax.block_until_ready(bst._gbdt.train_score.score)
+        return (time.perf_counter() - t0) / ITERS, ndcg
+
+    s_noeval, _ = run(False)
+    s_eval, ndcg = run(True)
+    out = {
+        "workload": f"synthetic MS-LTR-shaped lambdarank {ROWS}x137, "
+                    f"{len(q)} train queries, 255 leaves, 255 bins",
+        "backend": jax.default_backend(),
+        "iters": ITERS,
+        "bin_seconds": round(t_bin, 1),
+        "seconds_per_iter_no_eval": round(s_noeval, 4),
+        "seconds_per_iter_with_ndcg_eval_every_iter": round(s_eval, 4),
+        "eval_overhead_ratio": round(s_eval / s_noeval, 3),
+        "final_test_ndcg": {nm: round(float(v), 6)
+                            for _, nm, v, _ in (ndcg or [])},
+    }
+    with open(os.path.join(ROOT, "ltr_scale_measured.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -214,3 +214,24 @@ def test_weighted_training(binary_example):
     lgb.train(params, train, num_boost_round=10, valid_sets=[valid],
               evals_result=ev, verbose_eval=False)
     assert ev["valid_0"]["binary_logloss"][-1] < 0.66
+
+
+def test_uint16_bin_store_trains(binary_example):
+    """max_bin > 256 switches the store to uint16; the whole train path
+    (device histogram at B=512, split scan, predict) must work there."""
+    import lightgbm_tpu as lgb
+    X, y, Xt, yt = binary_example
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "max_bin": 500, "num_leaves": 15, "verbose": -1,
+              "min_data_in_leaf": 10}
+    train = lgb.Dataset(X, y)
+    valid = lgb.Dataset(Xt, yt, reference=train)
+    ev = {}
+    bst = lgb.train(params, train, num_boost_round=8, valid_sets=[valid],
+                    evals_result=ev, verbose_eval=False)
+    assert train._inner.bins.dtype == np.uint16
+    assert train._inner.max_num_bin > 256
+    ll = ev["valid_0"]["binary_logloss"]
+    assert ll[-1] < ll[0] - 0.03
+    p = bst.predict(Xt[:100])
+    assert np.isfinite(p).all()
