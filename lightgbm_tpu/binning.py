@@ -35,9 +35,9 @@ class BinMapper:
     is_trivial: bool = True
     # numerical
     bin_upper_bound: np.ndarray = field(default_factory=lambda: np.array([np.inf]))
-    # categorical
+    # categorical: bin i holds category bin_2_categorical[i] (the inverse
+    # map is the sorted lookup table value_to_bin builds lazily)
     bin_2_categorical: List[int] = field(default_factory=list)
-    categorical_2_bin: Dict[int, int] = field(default_factory=dict)
     min_val: float = 0.0
     max_val: float = 0.0
     default_bin: int = 0
@@ -258,7 +258,6 @@ def find_bin(sample_values: np.ndarray, total_sample_cnt: int, max_bin: int,
         nb = 0
         while (used_cnt < cut_cnt or nb < eff_max_bin) and nb < ivals_u.size:
             m.bin_2_categorical.append(int(ivals_u[nb]))
-            m.categorical_2_bin[int(ivals_u[nb])] = nb
             used_cnt += int(icounts[nb])
             nb += 1
         m.num_bin = nb

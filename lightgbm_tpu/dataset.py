@@ -307,21 +307,23 @@ def load_file_two_round(path: str, cfg: Config,
             n_x = arr.shape[1] - 1
             xw, xg, drop = _resolve_column_selectors(cfg, names, label_idx,
                                                      n_x)
-            # ONE fused column take per chunk: file columns minus the
-            # label minus any selector/ignored columns (X-space -> file-
-            # space is +1 past the label column)
+            # map every selector to FILE-space once (X-space -> file-space
+            # is +1 past the label column); per-chunk reads index arr
+            # directly, and the feature take is ONE fused column take of
+            # the kept file columns
             def _fcol(c):
                 return c + 1 if c >= label_idx else c
             dropped = set(drop)
             use_cols = [_fcol(c) for c in range(n_x) if c not in dropped]
             keep = ([c for c in range(n_x) if c not in dropped]
                     if drop else None)
-            sel = (xw, xg, keep, use_cols)
-        xw, xg, keep, use_cols = sel
-        if xw is not None:
-            wvals.append(arr[:, xw + 1 if xw >= label_idx else xw].copy())
-        if xg is not None:
-            gvals.append(arr[:, xg + 1 if xg >= label_idx else xg].copy())
+            sel = (None if xw is None else _fcol(xw),
+                   None if xg is None else _fcol(xg), keep, use_cols)
+        wcol, gcol, keep, use_cols = sel
+        if wcol is not None:
+            wvals.append(arr[:, wcol].copy())
+        if gcol is not None:
+            gvals.append(arr[:, gcol].copy())
         X = arr[:, use_cols]
         if sample is None:
             sample = np.empty((S, X.shape[1]), np.float64)
@@ -342,13 +344,13 @@ def load_file_two_round(path: str, cfg: Config,
     sample = sample[:filled]
     md = Metadata.load_side_files(path, n)
     md.label = np.asarray(y, np.float32)
-    xw, xg, keep, use_cols = sel
-    if xw is not None:
+    wcol, gcol, keep, use_cols = sel
+    if wcol is not None:
         if md.weights is not None:
             from . import log
             log.warning("weight_column overrides the .weight side file")
         md.weights = np.concatenate(wvals).astype(np.float32)
-    if xg is not None:
+    if gcol is not None:
         if md.query_boundaries is not None:
             from . import log
             log.warning("group_column overrides the .query side file")
@@ -606,8 +608,7 @@ class Dataset:
                 min_val=float(fl[0]), max_val=float(fl[1]),
                 sparse_rate=float(fl[2]),
                 bin_upper_bound=d[f"m{i}_upper"],
-                bin_2_categorical=cats,
-                categorical_2_bin={c: j for j, c in enumerate(cats)}))
+                bin_2_categorical=cats))
         ds.num_bins = np.array([ds.mappers[i].num_bin
                                 for i in ds.used_features], np.int32)
         ds.max_num_bin = int(ds.num_bins.max()) if ds.used_features else 1

@@ -1,0 +1,81 @@
+"""Generate docs/Parameters.md from the Config dataclass — the analog of
+the reference's hand-maintained docs/Parameters.md, kept un-driftable by
+deriving it from the single source of truth (config.py)."""
+import dataclasses
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from lightgbm_tpu.config import Config, PARAM_ALIASES  # noqa: E402
+
+
+def main():
+    inv = {}
+    for alias, canon in PARAM_ALIASES.items():
+        inv.setdefault(canon, []).append(alias)
+    lines = [
+        "# Parameters",
+        "",
+        "All parameters of `lightgbm_tpu`, generated from "
+        "`lightgbm_tpu/config.py` by `scripts/gen_parameters_doc.py` "
+        "(do not edit by hand; regenerate instead).",
+        "",
+        "Names, defaults, and aliases follow the reference "
+        "(`include/LightGBM/config.h:86-284`, alias table `:342-436`). "
+        "Parameters are accepted as Python `params` dict keys, as "
+        "`key=value` CLI arguments, and as `key = value` lines in a "
+        "config file.",
+        "",
+        "| Parameter | Default | Type | Aliases |",
+        "|---|---|---|---|",
+    ]
+    for f in dataclasses.fields(Config):
+        if f.default is not dataclasses.MISSING:
+            d = f.default
+        elif f.default_factory is not dataclasses.MISSING:  # type: ignore
+            d = f.default_factory()                          # type: ignore
+        else:
+            d = ""
+        dv = repr(d) if isinstance(d, str) else str(d)
+        t = (f.type.replace("typing.", "") if isinstance(f.type, str)
+             else getattr(f.type, "__name__", str(f.type)))
+        al = ", ".join(f"`{a}`" for a in sorted(inv.get(f.name, [])))
+        lines.append(f"| `{f.name}` | `{dv}` | {t} | {al} |")
+    lines += [
+        "",
+        "## Objectives",
+        "",
+        "`regression` (l2), `regression_l1`, `huber`, `fair`, `poisson`, "
+        "`binary`, `lambdarank`, `multiclass` (softmax), `multiclassova` "
+        "— reference `src/objective/` parity, see "
+        "`lightgbm_tpu/objectives.py`.",
+        "",
+        "## Metrics",
+        "",
+        "`l1`, `l2`, `rmse`, `huber`, `fair`, `poisson`, "
+        "`binary_logloss`, `binary_error`, `auc`, `multi_logloss`, "
+        "`multi_error`, `ndcg@k`, `map@k` — host and device "
+        "implementations (`lightgbm_tpu/metrics.py`, "
+        "`lightgbm_tpu/ops/eval.py`).",
+        "",
+        "## TPU-specific parameters",
+        "",
+        "- `histogram_dtype` (default `float32`): MXU input precision for "
+        "histogram accumulation; `bfloat16` is validated at AUC parity "
+        "(`tests/test_bf16.py`) and is the benchmark default.",
+        "- `tree_learner`: `serial` | `feature` | `data` | `voting` | "
+        "`data2d` — the distributed axes map onto a `jax.sharding.Mesh` "
+        "instead of socket/MPI machine lists.",
+        "",
+    ]
+    dest = os.path.join(ROOT, "docs", "Parameters.md")
+    os.makedirs(os.path.dirname(dest), exist_ok=True)
+    with open(dest, "w") as fh:
+        fh.write("\n".join(lines))
+    print(f"wrote {dest} ({len(dataclasses.fields(Config))} parameters)")
+
+
+if __name__ == "__main__":
+    main()

@@ -72,6 +72,7 @@ def main():
             bst.update()
             if with_eval:
                 ndcg = bst._gbdt.eval_valid()
+        jax.block_until_ready(bst._gbdt.train_score.score)
         t0 = time.perf_counter()
         for _ in range(ITERS):
             bst.update()

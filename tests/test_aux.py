@@ -76,3 +76,29 @@ def test_native_bin_numerical_matches_searchsorted():
     for j, (col, u) in enumerate(zip([0, 2, 3], uppers)):
         expect = np.searchsorted(u, X[:, col], side="left")
         np.testing.assert_array_equal(out[j], expect)
+
+
+@pytest.mark.quick
+def test_parameters_doc_in_sync(tmp_path):
+    """docs/Parameters.md is generated from config.py; drift fails here.
+    The generator runs against a COPY so a failing run never rewrites
+    the tracked file (which would make a retry silently pass)."""
+    import shutil
+    import subprocess
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    gen = os.path.join(root, "scripts", "gen_parameters_doc.py")
+    sandbox = tmp_path / "repo"
+    (sandbox / "scripts").mkdir(parents=True)
+    (sandbox / "docs").mkdir()
+    shutil.copy(gen, sandbox / "scripts" / "gen_parameters_doc.py")
+    env = dict(os.environ, PYTHONPATH=root)
+    r = subprocess.run([sys.executable, str(sandbox / "scripts" /
+                                            "gen_parameters_doc.py")],
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 0, r.stderr
+    fresh = (sandbox / "docs" / "Parameters.md").read_text()
+    tracked = open(os.path.join(root, "docs", "Parameters.md")).read()
+    assert fresh == tracked, \
+        "docs/Parameters.md is stale; run scripts/gen_parameters_doc.py"

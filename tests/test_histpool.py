@@ -45,8 +45,11 @@ def test_nocache_mode_matches_cache_mode(xy):
 def test_epsilon_shape_selects_bounded_path():
     """At Epsilon width (F=2000, 255 leaves) the learner honors
     histogram_pool_size: a tight budget selects the bounded path, a
-    roomy one keeps the cache; and at the full Epsilon geometry
-    (B=256) the DEFAULT budget already forces the bounded path."""
+    roomy one keeps the cache.  The unset default is device-aware
+    (a quarter of reported device memory, >= 1.5 GB floor): on a 16 GB
+    chip the 1.57 GB full-Epsilon cache stays on the fast subtraction
+    path, while the conservative floor would bound it."""
+    from lightgbm_tpu.learner.common import _default_pool_budget
     rng = np.random.RandomState(0)
     X = rng.randn(64, 2000)
     ds = InnerDataset(X, rng.rand(64))
@@ -57,5 +60,7 @@ def test_epsilon_shape_selects_bounded_path():
                                          histogram_pool_size=4000.0))
     assert roomy.cache_parent_hist
     # full Epsilon geometry: [255 leaves, 2000 features, 3, 256 bins] f32
-    # = 1.57 GB > the 1.5 GB default budget
-    assert 4 * 255 * 2000 * 3 * 256 > 1.5e9
+    eps_cache = 4 * 255 * 2000 * 3 * 256
+    assert eps_cache > 1.5e9          # the floor would force bounded mode
+    assert eps_cache <= 0.25 * 16e9   # a 16 GB chip keeps the cache
+    assert _default_pool_budget() >= 1.5e9
