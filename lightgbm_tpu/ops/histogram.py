@@ -83,6 +83,23 @@ def hist_xla(gb: jax.Array, vals: jax.Array, *, num_bins_padded: int,
 
 FEATURE_GROUP = 8  # features per kernel block (TPU second-minor tiling)
 
+# Row-chunk length per pallas grid cell.  Larger chunks amortize grid
+# overhead; VMEM per cell stays small (one-hot [CK, B] + vals [M, CK]).
+# Env-tunable for on-chip experiments; parsed defensively and rounded to
+# the 128-lane multiple the TPU block tiling requires.
+import os as _os
+
+
+def _hist_chunk_from_env() -> int:
+    try:
+        v = int(_os.environ.get("LGBT_HIST_CHUNK", "") or 2048)
+    except ValueError:
+        v = 2048
+    return max(512, (v // 128) * 128)
+
+
+HIST_CHUNK = _hist_chunk_from_env()
+
 
 def _hist_kernel(gb_ref, vals_ref, out_ref, *, B: int, input_dtype):
     """One (feature-group, row-chunk) grid cell.
@@ -128,7 +145,7 @@ def hist_pallas(gb_t: jax.Array, vals8: jax.Array, *, num_bins_padded: int,
     F, C = gb_t.shape
     B = num_bins_padded
     G = FEATURE_GROUP
-    Ck = min(C, 2048)
+    Ck = min(C, HIST_CHUNK)
     if C % Ck:
         # pad rows to a chunk multiple; padded slots have zero vals so they
         # contribute nothing to any bin
@@ -196,7 +213,7 @@ def hist_pallas_multileaf(gb_t: jax.Array, vals: jax.Array, *,
     M = vals.shape[0]
     B = num_bins_padded
     G = FEATURE_GROUP
-    Ck = min(C, 2048)
+    Ck = min(C, HIST_CHUNK)
     if C % Ck:
         pad = Ck - C % Ck
         gb_t = jnp.pad(gb_t, ((0, 0), (0, pad)))
@@ -340,7 +357,7 @@ def hist_multileaf_masked(gb_t: jax.Array, lid: jax.Array, gh8: jax.Array,
                          axis=2).transpose(1, 0, 2, 3)
 
     G = FEATURE_GROUP
-    Ck = min(C, 2048)
+    Ck = min(C, HIST_CHUNK)
     if C % Ck:
         pad = Ck - C % Ck
         gb_t = jnp.pad(gb_t, ((0, 0), (0, pad)))

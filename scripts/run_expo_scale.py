@@ -69,9 +69,11 @@ def main():
     # survives a text round-trip
     s = bst.model_to_string()
     bst2 = lgb.Booster(model_str=s)
-    idx = np.random.RandomState(1).choice(ROWS, 10_000, replace=False)
+    idx = np.random.RandomState(1).choice(ROWS, min(ROWS, 10_000),
+                                          replace=False)
     p1, p2 = bst.predict(X[idx]), bst2.predict(X[idx])
-    assert np.allclose(p1, p2, atol=1e-6)
+    roundtrip_max_delta = float(np.abs(p1 - p2).max())
+    assert roundtrip_max_delta < 1e-6, roundtrip_max_delta
     n_cat_splits = s.count("decision_type=1")
 
     auc = None
@@ -89,7 +91,7 @@ def main():
         "seconds_per_iter": round(s_iter, 4),
         "trees_with_categorical_splits": n_cat_splits > 0,
         "train_sample_auc": auc,
-        "model_roundtrip_exact": True,
+        "model_roundtrip_max_abs_delta": roundtrip_max_delta,
     }
     with open(os.path.join(ROOT, "expo_scale_measured.json"), "w") as f:
         json.dump(out, f, indent=1)

@@ -62,5 +62,30 @@ def test_epsilon_shape_selects_bounded_path():
     # full Epsilon geometry: [255 leaves, 2000 features, 3, 256 bins] f32
     eps_cache = 4 * 255 * 2000 * 3 * 256
     assert eps_cache > 1.5e9          # the floor would force bounded mode
-    assert eps_cache <= 0.25 * 16e9   # a 16 GB chip keeps the cache
     assert _default_pool_budget() >= 1.5e9
+
+
+@pytest.mark.quick
+def test_default_budget_reads_device_memory(monkeypatch):
+    """The device-aware branch: with a reported 16 GB bytes_limit the
+    default budget is 4 GB (so the 1.57 GB full-Epsilon cache keeps the
+    fast subtraction path); with no stats it falls back to the floor."""
+    import jax
+    from lightgbm_tpu.learner import common
+
+    class FakeDev:
+        def __init__(self, stats):
+            self._s = stats
+
+        def memory_stats(self):
+            return self._s
+
+    monkeypatch.setattr(jax, "devices",
+                        lambda: [FakeDev({"bytes_limit": 16e9})])
+    assert common._default_pool_budget() == 4e9
+    assert common.use_parent_hist_cache(
+        Config(num_leaves=255), 2000, 256)      # Epsilon cache fits
+    monkeypatch.setattr(jax, "devices", lambda: [FakeDev(None)])
+    assert common._default_pool_budget() == 1.5e9
+    assert not common.use_parent_hist_cache(
+        Config(num_leaves=255), 2000, 256)      # floor bounds it

@@ -86,3 +86,25 @@ def test_rounds_respects_num_leaves_cap(problem):
         "verbose": -1})
     tr, _ = RoundsTreeLearner(ds, cfg2, None).train(g, h)
     assert 1 < tr.num_leaves <= 8
+
+
+def test_pipelined_valid_scoring_matches_host_predict(binary_example):
+    """The pipelined path scores valid sets by traversing DEVICE
+    TreeArrays over binned values (score_updater.traverse_tree_device);
+    the final valid logloss must equal what the host raw-threshold tree
+    walk computes over the same model."""
+    import lightgbm_tpu as lgb
+    X, y, Xt, yt = binary_example
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "num_leaves": 15, "verbose": -1, "min_data_in_leaf": 10}
+    train = lgb.Dataset(X, y)
+    valid = lgb.Dataset(Xt, yt, reference=train)
+    ev = {}
+    bst = lgb.train(params, train, num_boost_round=10, valid_sets=[valid],
+                    evals_result=ev, verbose_eval=False)
+    raw = bst.predict(Xt, raw_score=True)
+    p = 1.0 / (1.0 + np.exp(-raw))
+    p = np.clip(p, 1e-15, 1 - 1e-15)
+    ll_host = float(np.mean(-(yt * np.log(p) + (1 - yt) * np.log1p(-p))))
+    ll_dev = ev["valid_0"]["binary_logloss"][-1]
+    assert abs(ll_host - ll_dev) < 2e-5, (ll_host, ll_dev)
