@@ -133,9 +133,11 @@ def _hist_kernel(gb_ref, vals_ref, out_ref, *, B: int, input_dtype):
             vals, oh, preferred_element_type=jnp.float32, precision=prec)
 
 
-@functools.partial(jax.jit, static_argnames=("num_bins_padded", "input_dtype"))
+@functools.partial(jax.jit, static_argnames=("num_bins_padded", "input_dtype",
+                                             "interpret"))
 def hist_pallas(gb_t: jax.Array, vals8: jax.Array, *, num_bins_padded: int,
-                input_dtype: str = "bfloat16") -> jax.Array:
+                input_dtype: str = "bfloat16",
+                interpret: bool = False) -> jax.Array:
     """Pallas histogram.  gb_t: [F, C] int32, vals8: [8, C] float32.
 
     Returns [F, 3, B] float32.
@@ -169,6 +171,7 @@ def hist_pallas(gb_t: jax.Array, vals8: jax.Array, *, num_bins_padded: int,
             pl.BlockSpec((8, Ck), lambda f, k: (0, k)),
         ],
         out_specs=pl.BlockSpec((1, G, 8, B), lambda f, k: (f, 0, 0, 0)),
+        interpret=interpret,
     )(gb_g, vals8)
     return out.reshape(Fg, 8, B)[:F, :3, :]
 
@@ -201,10 +204,12 @@ def _hist_kernel_ml(gb_ref, vals_ref, out_ref, *, B: int, input_dtype):
             vals, oh, preferred_element_type=jnp.float32, precision=prec)
 
 
-@functools.partial(jax.jit, static_argnames=("num_bins_padded", "input_dtype"))
+@functools.partial(jax.jit, static_argnames=("num_bins_padded", "input_dtype",
+                                             "interpret"))
 def hist_pallas_multileaf(gb_t: jax.Array, vals: jax.Array, *,
                           num_bins_padded: int,
-                          input_dtype: str = "bfloat16") -> jax.Array:
+                          input_dtype: str = "bfloat16",
+                          interpret: bool = False) -> jax.Array:
     """Multi-leaf pallas histogram.  gb_t: [F, C] int, vals: [M, C] f32
     (M a multiple of 8, ≤ 128).  Returns [F, M, B] f32."""
     from jax.experimental import pallas as pl
@@ -235,6 +240,7 @@ def hist_pallas_multileaf(gb_t: jax.Array, vals: jax.Array, *,
             pl.BlockSpec((M, Ck), lambda f, k: (0, k)),
         ],
         out_specs=pl.BlockSpec((1, G, M, B), lambda f, k: (f, 0, 0, 0)),
+        interpret=interpret,
     )(gb_g, vals)
     return out.reshape(Fg, M, B)[:F]
 
@@ -330,11 +336,12 @@ def _hist_kernel_masked(sl_ref, gb_ref, lid_ref, gh_ref, out_ref, *,
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins_padded", "backend",
-                                             "input_dtype"))
+                                             "input_dtype", "interpret"))
 def hist_multileaf_masked(gb_t: jax.Array, lid: jax.Array, gh8: jax.Array,
                           sl: jax.Array, *, num_bins_padded: int,
                           backend: str = "xla",
-                          input_dtype: str = "float32") -> jax.Array:
+                          input_dtype: str = "float32",
+                          interpret: bool = False) -> jax.Array:
     """Histogram K leaves in one pass, masks built on the fly.
 
     gb_t: [F, C] int bins; lid: [C] int32 leaf ids; gh8: [8, C] f32
@@ -386,6 +393,7 @@ def hist_multileaf_masked(gb_t: jax.Array, lid: jax.Array, gh8: jax.Array,
             pl.BlockSpec((8, Ck), lambda f, k: (0, k)),
         ],
         out_specs=pl.BlockSpec((1, G, Mp, B), lambda f, k: (f, 0, 0, 0)),
+        interpret=interpret,
     )(sl2, gb_g, lid[None, :], gh8)
     h = out.reshape(Fg, Mp, B)[:F]                       # [F, Mp, B]
     return jnp.stack([h[:, :K], h[:, K:2 * K], h[:, 2 * K:3 * K]],

@@ -29,7 +29,11 @@ def test_classifier(binary_example):
     assert set(clf.classes_) == {0.0, 1.0}
 
 
+@pytest.mark.slow
 def test_classifier_multiclass(multiclass_example):
+    """slow tier: the K>1 sklearn wrapper path; the default tier covers
+    multiclass via test_engine and the binary wrapper via
+    test_classifier."""
     X, y, Xt, yt = multiclass_example
     clf = LGBMClassifier(n_estimators=8, min_child_samples=10)
     clf.fit(X, y, verbose=False)
