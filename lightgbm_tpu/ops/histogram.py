@@ -22,7 +22,6 @@ Two implementations:
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -1,0 +1,114 @@
+"""Row/feature sampling end-to-end: bagging_fraction/bagging_freq and
+feature_fraction (reference gbdt.cpp:232-317 bagging, tree learner
+feature sampling via used-feature mask)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _data(n=2500, f=12, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.6 * X[:, 1] * X[:, 2] + 0.2 * rng.randn(n) > 0
+         ).astype(float)
+    return X, y
+
+
+def test_bagging_end_to_end():
+    X, y = _data()
+    ev = {}
+    bst = lgb.train({"objective": "binary", "metric": "binary_logloss",
+                     "bagging_fraction": 0.6, "bagging_freq": 2,
+                     "bagging_seed": 3, "num_leaves": 15, "verbose": -1,
+                     "min_data_in_leaf": 10},
+                    lgb.Dataset(X, y), num_boost_round=8,
+                    valid_sets=[lgb.Dataset(X, y)], evals_result=ev,
+                    verbose_eval=False)
+    ll = ev["valid_0"]["binary_logloss"]
+    assert ll[-1] < ll[0] - 0.1
+    # every non-stump tree saw ~60% of the rows (internal_count tracks
+    # the in-bag rows of the root split, gbdt.cpp bagging contract)
+    for t in bst._gbdt.models:
+        if t.num_leaves > 1 and t.internal_count[0] > 0:
+            assert 0.5 * 0.6 * len(y) < t.internal_count[0] <= 0.6 * len(y) + 1
+
+
+def test_bagging_deterministic_under_seed():
+    X, y = _data()
+    params = {"objective": "binary", "bagging_fraction": 0.5,
+              "bagging_freq": 1, "bagging_seed": 7, "num_leaves": 15,
+              "verbose": -1, "min_data_in_leaf": 10}
+    m1 = lgb.train(params, lgb.Dataset(X, y),
+                   num_boost_round=4).model_to_string()
+    m2 = lgb.train(params, lgb.Dataset(X, y),
+                   num_boost_round=4).model_to_string()
+    assert m1 == m2
+
+
+def test_feature_fraction_limits_split_features():
+    X, y = _data(f=16)
+    bst = lgb.train({"objective": "binary", "feature_fraction": 0.25,
+                     "feature_fraction_seed": 5, "num_leaves": 31,
+                     "verbose": -1, "min_data_in_leaf": 10},
+                    lgb.Dataset(X, y), num_boost_round=5)
+    k = max(1, int(round(16 * 0.25)))
+    n_trees = 0
+    for t in bst._gbdt.models:
+        if t.num_leaves <= 1:
+            continue
+        n_trees += 1
+        used = set(t.split_feature[: t.num_leaves - 1].tolist())
+        assert len(used) <= k, (used, k)
+    assert n_trees >= 3
+    # different trees draw different subsets (seeded rng advances)
+    all_used = set()
+    for t in bst._gbdt.models:
+        if t.num_leaves > 1:
+            all_used |= set(t.split_feature[: t.num_leaves - 1].tolist())
+    assert len(all_used) > k
+
+
+def test_init_score_seeds_training():
+    """init_score seeds the training scores (ScoreUpdater), suppresses
+    boost-from-average, and is NOT folded into predict() — reference
+    score_updater.hpp / gbdt.cpp boost_from_average gating."""
+    rng = np.random.RandomState(4)
+    n = 2000
+    X = rng.randn(n, 6)
+    y = 3.0 + X[:, 0] + 0.1 * rng.randn(n)
+    base = np.full(n, 3.0)
+    bst = lgb.train({"objective": "regression", "verbose": -1,
+                     "num_leaves": 15, "min_data_in_leaf": 10},
+                    lgb.Dataset(X, y, init_score=base),
+                    num_boost_round=20)
+    # no boost-from-average stump was inserted
+    assert not bst._gbdt.boost_from_average_used
+    pred = bst.predict(X)
+    # trees model the residual around the init score
+    assert np.mean((pred + base - y) ** 2) < 0.05
+    assert abs(np.mean(pred)) < 0.5          # centered residual model
+
+
+def test_predict_num_iteration_truncates():
+    """predict(num_iteration=k) scores with only the first k iterations
+    (reference Predict* num_iteration semantics), and NaN features route
+    rows through the default (<=threshold on bin 0) path, not a crash."""
+    X, y = _data()
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "num_leaves": 15, "min_data_in_leaf": 10},
+                    lgb.Dataset(X, y), num_boost_round=8)
+    p_full = bst.predict(X[:200], raw_score=True)
+    p_5 = bst.predict(X[:200], num_iteration=5, raw_score=True)
+    assert not np.allclose(p_full, p_5)
+    # manual truncation oracle: sum the first 5 boosted trees (+ the
+    # boost-from-average stump when present)
+    extra = 1 if bst._gbdt.boost_from_average_used else 0
+    manual = np.zeros(200)
+    for t in bst._gbdt.models[: 5 + extra]:
+        manual += t.predict_raw(X[:200])
+    np.testing.assert_allclose(p_5, manual, rtol=1e-6)
+    Xn = X[:50].copy()
+    Xn[:, 0] = np.nan
+    pn = bst.predict(Xn)
+    assert np.isfinite(pn).all()
