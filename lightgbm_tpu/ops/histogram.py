@@ -47,6 +47,7 @@ def hist_xla(gb: jax.Array, vals: jax.Array, *, num_bins_padded: int,
     vals : [3, C] float32 rows (grad, hess, count-mask).
     Returns [F, 3, B] float32.
     """
+    input_dtype = _coerce_dtype(input_dtype)
     C, F = gb.shape
     B = num_bins_padded
     dt = jnp.dtype(input_dtype)
@@ -100,6 +101,20 @@ def _hist_chunk_from_env() -> int:
 HIST_CHUNK = _hist_chunk_from_env()
 
 
+def _coerce_dtype(input_dtype: str) -> str:
+    """int8 means caller-side gradient quantization, which only the
+    rounds learner's masked kernel implements; a bare int8 cast would
+    TRUNCATE real-valued grads, so every other kernel runs f32 and says
+    so (the warning fires once per compile, at trace time)."""
+    if input_dtype == "int8":
+        from .. import log
+        log.warning("histogram_dtype=int8 is only supported by the "
+                    "batched-rounds learner; using float32 here")
+        return "float32"
+    return input_dtype
+
+
+
 def _hist_kernel(gb_ref, vals_ref, out_ref, *, B: int, input_dtype):
     """One (feature-group, row-chunk) grid cell.
 
@@ -141,6 +156,7 @@ def hist_pallas(gb_t: jax.Array, vals8: jax.Array, *, num_bins_padded: int,
 
     Returns [F, 3, B] float32.
     """
+    input_dtype = _coerce_dtype(input_dtype)
     from jax.experimental import pallas as pl
 
     F, C = gb_t.shape
@@ -211,6 +227,7 @@ def hist_pallas_multileaf(gb_t: jax.Array, vals: jax.Array, *,
                           interpret: bool = False) -> jax.Array:
     """Multi-leaf pallas histogram.  gb_t: [F, C] int, vals: [M, C] f32
     (M a multiple of 8, ≤ 128).  Returns [F, M, B] f32."""
+    input_dtype = _coerce_dtype(input_dtype)
     from jax.experimental import pallas as pl
 
     F, C = gb_t.shape
@@ -249,6 +266,7 @@ def hist_multileaf_xla(gb_t: jax.Array, vals: jax.Array, *,
                        input_dtype: str = "float32") -> jax.Array:
     """XLA fallback for the multi-leaf histogram (CPU tests / non-TPU).
     gb_t: [F, C] int, vals: [M, C] f32 → [F, M, B] f32."""
+    input_dtype = _coerce_dtype(input_dtype)
     B = num_bins_padded
     dt = jnp.dtype(input_dtype)
     prec = (jax.lax.Precision.HIGHEST if dt == jnp.float32
@@ -334,6 +352,57 @@ def _hist_kernel_masked(sl_ref, gb_ref, lid_ref, gh_ref, out_ref, *,
             vals, oh, preferred_element_type=jnp.float32, precision=prec)
 
 
+def _hist_kernel_masked_q(sl_ref, gb_ref, lid_ref, ghq_ref, out_ref, *,
+                          B: int, K: int):
+    """int8-quantized variant of _hist_kernel_masked: vals and one-hot
+    are int8 and the contraction accumulates exactly in int32 (v5e runs
+    int8 MXU matmuls at 2x bf16 throughput).  ghq rows are pre-quantized
+    (round(grad/scale_g), round(hess/scale_h), 0/1 mask) stored widened
+    as int32; dequantization happens in the caller.  Every product is
+    exact: masks are 0/1 and |q| <= 127.  Accumulation is exact while
+    127 * rows_per_device < 2^31 — the caller enforces a 16M-row bound
+    and falls back to bfloat16 beyond it."""
+    from jax.experimental import pallas as pl
+
+    k = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    lid = lid_ref[0, :]
+    sl = sl_ref[:K, 0:1]
+    m = (lid[None, :] == sl).astype(jnp.int8)            # [K, Ck]
+    gq = ghq_ref[0:1, :].astype(jnp.int8)
+    hq = ghq_ref[1:2, :].astype(jnp.int8)
+    rm = ghq_ref[2:3, :].astype(jnp.int8)
+    vals = jnp.concatenate([m * gq, m * hq, m * rm], axis=0)  # [3K, Ck] i8
+    Mp = out_ref.shape[2]
+    if Mp > 3 * K:
+        vals = jnp.concatenate(
+            [vals, jnp.zeros((Mp - 3 * K, vals.shape[1]), jnp.int8)],
+            axis=0)
+    G = gb_ref.shape[1]
+    for g_ in range(G):
+        gb = gb_ref[0, g_, :]
+        oh = (gb[:, None] == jax.lax.broadcasted_iota(
+            jnp.int32, (1, B), 1)).astype(jnp.int8)
+        out_ref[0, g_, :, :] += jnp.dot(
+            vals, oh, preferred_element_type=jnp.int32)
+
+
+def _quantize_gh(gh8):
+    """Per-pass symmetric int8 quantization of the grad/hess rows.
+    Returns (ghq [8, C] int32 holding int8-ranged values, scale_g,
+    scale_h).  The mask row is carried through exactly (0/1)."""
+    sg = jnp.maximum(jnp.max(jnp.abs(gh8[0])), 1e-30) / 127.0
+    sh = jnp.maximum(jnp.max(jnp.abs(gh8[1])), 1e-30) / 127.0
+    ghq = jnp.concatenate([
+        jnp.round(gh8[0:1] / sg), jnp.round(gh8[1:2] / sh), gh8[2:3],
+        jnp.zeros_like(gh8[3:])], axis=0).astype(jnp.int32)
+    return ghq, sg, sh
+
+
 @functools.partial(jax.jit, static_argnames=("num_bins_padded", "backend",
                                              "input_dtype", "interpret"))
 def hist_multileaf_masked(gb_t: jax.Array, lid: jax.Array, gh8: jax.Array,
@@ -346,14 +415,40 @@ def hist_multileaf_masked(gb_t: jax.Array, lid: jax.Array, gh8: jax.Array,
     gb_t: [F, C] int bins; lid: [C] int32 leaf ids; gh8: [8, C] f32
     (grad·rm, hess·rm, rm, pads); sl: [K] int32 leaf ids to histogram
     (-1 = empty slot).  Returns [K, F, 3, B] f32.
+
+    input_dtype "int8" (EXPERIMENTAL, opt-in) selects per-pass symmetric
+    gradient quantization with exact int32 accumulation: counts are
+    exact, grad/hess entries carry <= |max|/254 absolute rounding error
+    each — far finer than LightGBM-4-style 2-5 bit quantized training.
+    The XLA fallback emulates the same dequantized values so CPU runs
+    reproduce the TPU behavior.
     """
     from jax.experimental import pallas as pl
 
     F, C = gb_t.shape
     K = sl.shape[0]
     B = num_bins_padded
+    quant = input_dtype == "int8"
+    # int32-accumulator safety: with constant hessians every row
+    # quantizes to exactly 127, so one bin can accumulate 127*C — keep
+    # 127*C < 2^31 (and per-bin counts < 2^24 so the f32 conversion
+    # stays exact).  Shapes are static, so this resolves at trace time.
+    if quant and C > 16_000_000:
+        from .. import log
+        log.warning("histogram_dtype=int8 disabled for this pass: "
+                    f"{C} rows exceeds the int32-exactness bound "
+                    "(16M rows per device); using bfloat16")
+        quant = False
+        input_dtype = "bfloat16"
 
     if backend != "pallas":
+        if quant:
+            ghq, sg, sh = _quantize_gh(gh8)
+            gh8 = jnp.concatenate([
+                ghq[0:1].astype(jnp.float32) * sg,
+                ghq[1:2].astype(jnp.float32) * sh,
+                gh8[2:3], gh8[3:]], axis=0)
+            input_dtype = "float32"
         m = (lid[None, :] == sl[:, None]).astype(jnp.float32)
         vals = jnp.concatenate(
             [m * gh8[0:1], m * gh8[1:2], m * gh8[2:3]], axis=0)  # [3K, C]
@@ -379,18 +474,35 @@ def hist_multileaf_masked(gb_t: jax.Array, lid: jax.Array, gh8: jax.Array,
     sl2 = jnp.broadcast_to(jnp.pad(sl, (0, Kp - K),
                                    constant_values=-1)[:, None], (Kp, 128))
     grid = (Fg // G, C // Ck)
-    dt = jnp.dtype(input_dtype)
+    in_specs = [
+        pl.BlockSpec((Kp, 128), lambda f, k: (0, 0)),
+        pl.BlockSpec((1, G, Ck), lambda f, k: (f, 0, k)),
+        pl.BlockSpec((1, Ck), lambda f, k: (0, k)),
+        pl.BlockSpec((8, Ck), lambda f, k: (0, k)),
+    ]
 
+    if quant:
+        ghq, sg, sh = _quantize_gh(gh8)
+        out = pl.pallas_call(
+            functools.partial(_hist_kernel_masked_q, B=B, K=K),
+            out_shape=jax.ShapeDtypeStruct((Fg // G, G, Mp, B), jnp.int32),
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, G, Mp, B),
+                                   lambda f, k: (f, 0, 0, 0)),
+            interpret=interpret,
+        )(sl2, gb_g, lid[None, :], ghq)
+        h = out.reshape(Fg, Mp, B)[:F].astype(jnp.float32)
+        return jnp.stack([h[:, :K] * sg, h[:, K:2 * K] * sh,
+                          h[:, 2 * K:3 * K]],
+                         axis=2).transpose(1, 0, 2, 3)
+
+    dt = jnp.dtype(input_dtype)
     out = pl.pallas_call(
         functools.partial(_hist_kernel_masked, B=B, K=K, input_dtype=dt),
         out_shape=jax.ShapeDtypeStruct((Fg // G, G, Mp, B), jnp.float32),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((Kp, 128), lambda f, k: (0, 0)),
-            pl.BlockSpec((1, G, Ck), lambda f, k: (f, 0, k)),
-            pl.BlockSpec((1, Ck), lambda f, k: (0, k)),
-            pl.BlockSpec((8, Ck), lambda f, k: (0, k)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, G, Mp, B), lambda f, k: (f, 0, 0, 0)),
         interpret=interpret,
     )(sl2, gb_g, lid[None, :], gh8)
