@@ -50,6 +50,9 @@ ROWS = int(os.environ.get("BENCH_ROWS", 10_500_000))
 ITERS = int(os.environ.get("BENCH_ITERS", 60))
 WARMUP = int(os.environ.get("BENCH_WARMUP", 3))
 LEAVES = int(os.environ.get("BENCH_LEAVES", 255))
+# histogram MXU precision; bfloat16 is the validated default
+# (tests/test_bf16.py), int8 is the experimental quantized kernel
+HIST_DTYPE = os.environ.get("BENCH_HIST_DTYPE", "bfloat16")
 
 
 def synth_higgs(n, f=28, seed=42):
@@ -86,7 +89,7 @@ def main():
         # bf16 histogram operands: validated at AUC parity with f32 on
         # this workload (the reference GPU path makes the same
         # single-precision trade, docs/GPU-Performance.md:130-134)
-        "histogram_dtype": "bfloat16",
+        "histogram_dtype": HIST_DTYPE,
     }
     train = lgb.Dataset(X, y)
     bst = lgb.Booster(params, train)

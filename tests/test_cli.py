@@ -51,8 +51,8 @@ def test_cli_continue_training(tmp_path, regression_example):
         f"data={EX}/regression/regression.train", "objective=regression",
         "verbosity=-1", "min_data_in_leaf=20",
     ]
-    assert main(base + ["num_trees=8", f"output_model={m1}"]) == 0
-    assert main(base + ["num_trees=8", f"input_model={m1}",
+    assert main(base + ["num_trees=5", f"output_model={m1}"]) == 0
+    assert main(base + ["num_trees=5", f"input_model={m1}",
                         f"output_model={m2}"]) == 0
     b1 = lgb.Booster(model_file=str(m1))
     b2 = lgb.Booster(model_file=str(m2))

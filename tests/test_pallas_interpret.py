@@ -125,26 +125,3 @@ def test_hist_masked_int8_quantized_kernel():
     assert (np.abs(np.asarray(h_q)[:, :, 1] - np.asarray(h_f)[:, :, 1])
             <= bound_h).all()
 
-
-def test_int8_histogram_trains_end_to_end():
-    """histogram_dtype=int8 through the full rounds-learner training loop
-    (XLA emulation on CPU): quality within a small delta of f32."""
-    import lightgbm_tpu as lgb
-    rng = np.random.RandomState(9)
-    n = 3000
-    X = rng.randn(n, 8)
-    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] > 0).astype(float)
-
-    def final_ll(dtype):
-        ev = {}
-        lgb.train({"objective": "binary", "metric": "binary_logloss",
-                   "num_leaves": 31, "verbose": -1, "min_data_in_leaf": 10,
-                   "histogram_dtype": dtype, "tree_growth": "rounds"},
-                  lgb.Dataset(X, y), num_boost_round=10,
-                  valid_sets=[lgb.Dataset(X, y)], evals_result=ev,
-                  verbose_eval=False)
-        return ev["valid_0"]["binary_logloss"][-1]
-
-    ll_f32 = final_ll("float32")
-    ll_i8 = final_ll("int8")
-    assert ll_i8 < ll_f32 + 0.02, (ll_i8, ll_f32)

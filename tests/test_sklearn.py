@@ -11,7 +11,7 @@ from lightgbm_tpu import LGBMClassifier, LGBMRegressor, LGBMRanker
 
 def test_regressor(regression_example):
     X, y, Xt, yt = regression_example
-    reg = LGBMRegressor(n_estimators=20, min_child_samples=10)
+    reg = LGBMRegressor(n_estimators=14, min_child_samples=10)
     reg.fit(X, y, eval_set=[(Xt, yt)], verbose=False)
     mse = np.mean((reg.predict(Xt) - yt) ** 2)
     assert mse < 1.0
@@ -19,7 +19,7 @@ def test_regressor(regression_example):
 
 def test_classifier(binary_example):
     X, y, Xt, yt = binary_example
-    clf = LGBMClassifier(n_estimators=20, min_child_samples=10)
+    clf = LGBMClassifier(n_estimators=14, min_child_samples=10)
     clf.fit(X, y, verbose=False)
     proba = clf.predict_proba(Xt)
     assert proba.shape == (len(yt), 2)
