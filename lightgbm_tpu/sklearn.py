@@ -257,6 +257,20 @@ class LGBMModel(_SkBase):
     def evals_result_(self) -> Dict:
         return self.evals_result
 
+    # deprecated accessors kept for drop-in compatibility
+    # (reference sklearn.py:480-487 keeps both spellings)
+    def booster(self) -> Booster:
+        import warnings
+        warnings.warn("Use attribute booster_ instead.",
+                      DeprecationWarning)
+        return self.booster_
+
+    def feature_importance(self) -> np.ndarray:
+        import warnings
+        warnings.warn("Use attribute feature_importances_ instead.",
+                      DeprecationWarning)
+        return self.feature_importances_
+
 
 class LGBMRegressor(_SkRegressorMixin, LGBMModel):
     def __init__(self, objective: str = "regression", **kwargs):

@@ -263,7 +263,7 @@ def test_int8_histogram_trains_end_to_end():
 
 
 @pytest.mark.slow
-def test_original_length_guards(binary_example, regression_example):
+def test_original_length_guards(binary_example, regression_example, tmp_path):
     """Original-length versions of the checks the default tier shortened
     for the <300s budget (cv@8x3, sklearn@20 estimators, CLI continue
     @8+8): full sensitivity lives here."""
@@ -283,27 +283,16 @@ def test_original_length_guards(binary_example, regression_example):
     reg = LGBMRegressor(n_estimators=20, min_child_samples=10)
     reg.fit(Xr, yr, verbose=False)
     assert np.mean((reg.predict(Xrt) - yrt) ** 2) < 0.95
-    # CLI continue-training at the original 8+8 trees
-    import os
-    import subprocess
-    import sys
-    import tempfile
-    tmp = tempfile.mkdtemp()
-    m1 = os.path.join(tmp, "m1.txt")
-    m2 = os.path.join(tmp, "m2.txt")
+    # CLI continue-training at the original 8+8 trees (in-process like
+    # tests/test_cli.py, so the warm JAX session/compile cache is reused)
+    from lightgbm_tpu.application import main
+    m1 = str(tmp_path / "m1.txt")
+    m2 = str(tmp_path / "m2.txt")
     base = ["data=/root/reference/examples/regression/regression.train",
             "objective=regression", "verbosity=-1", "min_data_in_leaf=20"]
-
-    def cli(args):
-        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=os.path.
-                   dirname(os.path.dirname(os.path.abspath(__file__))))
-        return subprocess.run([sys.executable, "-m", "lightgbm_tpu"]
-                              + args, capture_output=True, text=True,
-                              env=env, timeout=300).returncode
-
-    assert cli(base + ["num_trees=8", f"output_model={m1}"]) == 0
-    assert cli(base + ["num_trees=8", f"input_model={m1}",
-                       f"output_model={m2}"]) == 0
+    assert main(base + ["num_trees=8", f"output_model={m1}"]) == 0
+    assert main(base + ["num_trees=8", f"input_model={m1}",
+                        f"output_model={m2}"]) == 0
     b1 = lgb.Booster(model_file=m1)
     b2 = lgb.Booster(model_file=m2)
     assert b2.num_trees() > b1.num_trees()
