@@ -52,8 +52,10 @@ NEG_INF = -jnp.inf
 # hist matmul; M > 128 tiles onto the MXU, and a LARGER K means FEWER
 # full-row passes per round — the per-pass costs (one-hot construction on
 # the VPU, bin reads from HBM) amortize over more leaves.  84 (M=256)
-# measured fastest on v5e at the north-star shape; overridable for
-# experiments via LGBT_LEAVES_PER_BATCH.
+# halves the pass count of the old 42 at constant MXU work, so the
+# pass-count model predicts it faster; grown trees are K-independent
+# (tests/test_rounds.py) and LGBT_LEAVES_PER_BATCH overrides for
+# on-chip tuning (scripts/profile_hotpath.py).
 import os as _os
 LEAVES_PER_BATCH = max(1, int(_os.environ.get("LGBT_LEAVES_PER_BATCH",
                                               "84") or 84))
