@@ -74,8 +74,8 @@ def main():
         # degrade instead of hanging: CPU backend, small workload, and an
         # explicit note so the record shows WHY this is not a TPU number
         force_cpu_backend()
-        ROWS = min(ROWS, 200_000)
-        ITERS = min(ITERS, 5)
+        ROWS = min(ROWS, 100_000)
+        ITERS = min(ITERS, 3)
         note = ("TPU backend unreachable (remote tunnel did not answer a "
                 "150s probe); CPU fallback at reduced shape - NOT the "
                 "tracked metric")

@@ -53,9 +53,10 @@ NEG_INF = -jnp.inf
 # full-row passes per round — the per-pass costs (one-hot construction on
 # the VPU, bin reads from HBM) amortize over more leaves.  84 (M=256)
 # halves the pass count of the old 42 at constant MXU work, so the
-# pass-count model predicts it faster; grown trees are K-independent
-# (tests/test_rounds.py) and LGBT_LEAVES_PER_BATCH overrides for
-# on-chip tuning (scripts/profile_hotpath.py).
+# pass-count model predicts it faster; grown trees agree across K up to
+# f32 summation-order ulps (tests/test_rounds.py::
+# test_leaves_per_batch_k_independent) and LGBT_LEAVES_PER_BATCH
+# overrides for on-chip tuning (scripts/profile_hotpath.py).
 import os as _os
 LEAVES_PER_BATCH = max(1, int(_os.environ.get("LGBT_LEAVES_PER_BATCH",
                                               "84") or 84))

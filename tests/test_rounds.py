@@ -108,3 +108,28 @@ def test_pipelined_valid_scoring_matches_host_predict(binary_example):
     ll_host = float(np.mean(-(yt * np.log(p) + (1 - yt) * np.log1p(-p))))
     ll_dev = ev["valid_0"]["binary_logloss"][-1]
     assert abs(ll_host - ll_dev) < 2e-5, (ll_host, ll_dev)
+
+
+def test_leaves_per_batch_k_independent(monkeypatch):
+    """LEAVES_PER_BATCH is a perf knob: changing K only regroups the
+    histogram matmuls, so grown models agree up to f32 summation-order
+    ulps (XLA may tile the contraction differently per M, which can flip
+    exact-tie splits; predictions must still agree to float tolerance)."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.learner import rounds as rounds_mod
+    rng = np.random.RandomState(12)
+    X = rng.randn(1500, 8)
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] > 0).astype(float)
+    params = {"objective": "binary", "num_leaves": 31, "verbose": -1,
+              "min_data_in_leaf": 10, "tree_growth": "rounds"}
+
+    def preds_at(k):
+        monkeypatch.setattr(rounds_mod, "LEAVES_PER_BATCH", k)
+        bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=4)
+        return bst.predict(X), [t.num_leaves for t in bst._gbdt.models]
+
+    p_small, n_small = preds_at(7)
+    p_default, n_default = preds_at(84)
+    assert n_small == n_default
+    np.testing.assert_allclose(p_small, p_default, atol=2e-3)
+    assert np.mean(np.abs(p_small - p_default) < 1e-6) > 0.95
