@@ -56,14 +56,15 @@ class BinMapper:
         # instead of a Python loop per category (Expo-scale data has
         # hundreds of categories x millions of rows)
         cs = getattr(self, "_cat_sorted", None)
-        # rebuild when the category list was replaced (identity) OR
-        # mutated in place (length) since the table was built
-        if (cs is None or cs[2] is not self.bin_2_categorical
-                or len(cs[0]) != len(self.bin_2_categorical)):
+        # rebuild when the category list changed since the table was
+        # built; the snapshot tuple compares by VALUE, so in-place
+        # element mutation is caught too (not just replacement/append)
+        snap = tuple(self.bin_2_categorical)
+        if cs is None or cs[2] != snap:
             cats = np.asarray(self.bin_2_categorical, np.int64)
             order = np.argsort(cats)
             cs = (cats[order], np.arange(len(cats), dtype=np.int32)[order],
-                  self.bin_2_categorical)
+                  snap)
             self._cat_sorted = cs
         cats_sorted, bins_sorted = cs[0], cs[1]
         iv = values.astype(np.int64)

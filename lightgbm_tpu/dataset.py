@@ -136,6 +136,26 @@ class Metadata:
         self.query_boundaries = np.concatenate(
             [[0], np.cumsum(sizes)]).astype(np.int32)
 
+    @property
+    def query_weights(self) -> Optional[np.ndarray]:
+        """Per-query weight = MEAN of the row weights over the query's
+        rows, derived only when both row weights and query boundaries
+        exist (metadata.cpp:457-470 LoadQueryWeights).  NDCG/MAP average
+        per-query results by these (rank_metric.hpp:113-136,
+        map_metric.hpp:113-130); lambdarank itself uses ROW weights
+        directly (rank_objective.hpp:164-167)."""
+        if self.weights is None or self.query_boundaries is None:
+            return None
+        qb = self.query_boundaries.astype(np.int64)
+        sizes = np.diff(qb)
+        # prefix-sum differences instead of add.reduceat: reduceat
+        # raises/mis-sums on zero-size queries, this is exact for them
+        # (an empty query gets weight 0)
+        csum = np.concatenate([[0.0], np.cumsum(
+            self.weights.astype(np.float64))])
+        sums = csum[qb[1:]] - csum[qb[:-1]]
+        return (sums / np.maximum(sizes, 1)).astype(np.float32)
+
     @staticmethod
     def load_side_files(data_path: str, num_data: int) -> "Metadata":
         """Load `<data>.weight`, `<data>.init`, `<data>.query` if present
@@ -339,6 +359,10 @@ def load_file_two_round(path: str, cfg: Config,
                 slots = rng.randint(0, S, size=int(accept.sum()))
                 sample[slots] = rest[accept]
         n_seen += len(X)
+    if sel is None or n_seen == 0:
+        # match the one-shot loader's error instead of an opaque unpack
+        # failure further down
+        raise ValueError(f"empty data file: {path}")
     y = np.concatenate(labels)
     n = len(y)
     sample = sample[:filled]

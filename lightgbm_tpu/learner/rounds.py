@@ -58,8 +58,29 @@ NEG_INF = -jnp.inf
 # test_leaves_per_batch_k_independent) and LGBT_LEAVES_PER_BATCH
 # overrides for on-chip tuning (scripts/profile_hotpath.py).
 import os as _os
-LEAVES_PER_BATCH = max(1, int(_os.environ.get("LGBT_LEAVES_PER_BATCH",
-                                              "84") or 84))
+
+
+def _leaves_per_batch_from_env() -> int:
+    """Defensive parse (a malformed value must not break every import)
+    clamped to [1, 336]: 3K is the matmul M dim and the masked kernel's
+    VMEM vals block is [3K, chunk] — 336 (M=1024) is ~8 MB at the
+    default chunk, a safe ceiling well past any profitable K."""
+    raw = _os.environ.get("LGBT_LEAVES_PER_BATCH", "") or "84"
+    try:
+        v = int(raw)
+    except ValueError:
+        from .. import log
+        log.warning(f"ignoring malformed LGBT_LEAVES_PER_BATCH={raw!r}; "
+                    "using 84")
+        v = 84
+    c = max(1, min(v, 336))
+    if c != v:
+        from .. import log
+        log.warning(f"LGBT_LEAVES_PER_BATCH={v} clamped to {c}")
+    return c
+
+
+LEAVES_PER_BATCH = _leaves_per_batch_from_env()
 
 
 def _psum(x, axis):

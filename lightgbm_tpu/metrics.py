@@ -264,6 +264,17 @@ class MultiErrorMetric(MultiLoglossMetric):
         return [(self.name, self._avg(err))]
 
 
+def _query_weighted_mean(per_query: np.ndarray,
+                         qw: Optional[np.ndarray]) -> float:
+    """sum(metric_q * qw_q) / sum(qw_q); uniform when no query weights
+    (rank_metric.hpp:113-142, map_metric.hpp:113-133 — qw derived as the
+    per-query mean row weight, metadata.cpp:457-470)."""
+    if qw is None:
+        return float(per_query.mean())
+    w = qw.astype(np.float64)
+    return float(np.sum(per_query * w) / np.sum(w))
+
+
 def _dcg_tables(config: Config, max_len: int):
     gains = config.label_gain
     if not gains:
@@ -277,6 +288,13 @@ class NDCGMetric(Metric):
     name = "ndcg"
     factor_to_bigger_better = 1.0
 
+    def _host_qw(self):
+        """query_weights derivation is O(N); cache it — weights are
+        fixed after metric init (same lifetime as the device cache)."""
+        if not hasattr(self, "_host_qw_cache"):
+            self._host_qw_cache = self.metadata.query_weights
+        return self._host_qw_cache
+
     def _dev_rank(self):
         """Device query structures shared by ndcg/map: query id per row,
         query start per row, and the DCG tables."""
@@ -288,24 +306,26 @@ class NDCGMetric(Metric):
                             sizes)
             qstart = np.repeat(qb[:-1].astype(np.int32), sizes)
             label_gain, discount = _dcg_tables(self.config, self.num_data)
+            qw = self.metadata.query_weights
             self._dev_rank_cache = (
                 jnp.asarray(qid), jnp.asarray(qstart),
                 jnp.asarray(label_gain.astype(np.float32)),
                 jnp.asarray(discount.astype(np.float32)),
-                len(sizes))
+                len(sizes),
+                None if qw is None else jnp.asarray(qw))
         return self._dev_rank_cache
 
     def eval_device(self, score, objective=None):
         if self.metadata.query_boundaries is None:
             return None
         from .ops import eval as deval
-        qid, qstart, gain_t, disc_t, Q = self._dev_rank()
+        qid, qstart, gain_t, disc_t, Q, qw = self._dev_rank()
         if not hasattr(self, "_dev_li"):
             import jax.numpy as jnp
             self._dev_li = jnp.asarray(self.label.astype(np.int32))
         ks = tuple(int(k) for k in self.config.ndcg_eval_at)
         vals = deval.ndcg_at_k(score.reshape(-1), self._dev_li, qid, qstart,
-                               gain_t, disc_t, ks=ks, num_queries=Q)
+                               gain_t, disc_t, qw, ks=ks, num_queries=Q)
         vals = np.asarray(vals)
         return [(f"ndcg@{k}", float(vals[i])) for i, k in enumerate(ks)]
 
@@ -319,6 +339,7 @@ class NDCGMetric(Metric):
         if qb is None:
             raise ValueError("NDCG metric requires query information")
         ks = list(self.config.ndcg_eval_at)
+        qw = self._host_qw()
         s = score.reshape(-1)
         lab = self.label.astype(np.int64)
         n = len(s)
@@ -346,7 +367,7 @@ class NDCGMetric(Metric):
             # all-zero-gain queries count as 1 (rank_metric.hpp convention)
             nd = np.where(maxdcg > 0,
                           dcg / np.maximum(maxdcg, 1e-300), 1.0)
-            out.append((f"ndcg@{k}", float(nd.mean())))
+            out.append((f"ndcg@{k}", _query_weighted_mean(nd, qw)))
         return out
 
 
@@ -359,12 +380,12 @@ class MAPMetric(NDCGMetric):
             return None
         from .ops import eval as deval
         import jax.numpy as jnp
-        qid, qstart, _, _, Q = self._dev_rank()
+        qid, qstart, _, _, Q, qw = self._dev_rank()
         if not hasattr(self, "_dev_lpos"):
             self._dev_lpos = jnp.asarray((self.label > 0))
         ks = tuple(int(k) for k in self.config.ndcg_eval_at)
         vals = deval.map_at_k(score.reshape(-1), self._dev_lpos, qid, qstart,
-                              ks=ks, num_queries=Q)
+                              qw, ks=ks, num_queries=Q)
         vals = np.asarray(vals)
         return [(f"map@{k}", float(vals[i])) for i, k in enumerate(ks)]
 
@@ -375,6 +396,7 @@ class MAPMetric(NDCGMetric):
         if qb is None:
             raise ValueError("MAP metric requires query information")
         ks = list(self.config.ndcg_eval_at)
+        qw = self._host_qw()
         s = score.reshape(-1)
         rel_all = (self.label > 0).astype(np.float64)
         n = len(s)
@@ -401,7 +423,7 @@ class MAPMetric(NDCGMetric):
             nrel = np.bincount(qid_sorted, weights=np.where(
                 within, rel, 0.0), minlength=Q)
             ap = np.where(nrel > 0, ap_num / np.maximum(nrel, 1.0), 0.0)
-            out.append((f"map@{k}", float(ap.sum() / Q)))
+            out.append((f"map@{k}", _query_weighted_mean(ap, qw)))
         return out
 
 

@@ -109,6 +109,16 @@ def multi_error(score, label_int, w, sum_w):
 # ---------------------------------------------------------------------------
 # ranking metrics — vectorized over all queries at once
 # ---------------------------------------------------------------------------
+
+
+def _qw_mean(per_query, query_weight):
+    """Query-weighted average of a [Q] per-query metric vector; uniform
+    mean when query_weight is None (the traced signature differs, so
+    each case compiles its own specialization)."""
+    if query_weight is None:
+        return jnp.mean(per_query)
+    w = query_weight.astype(jnp.float32)
+    return jnp.sum(per_query * w) / jnp.sum(w)
 # The reference walks queries one by one (rank_metric.hpp, map_metric.hpp);
 # at MS-LTR scale (~31k queries) a per-query host loop dominates training.
 # Here the per-query sort becomes ONE lexicographic sort of all rows keyed
@@ -116,13 +126,16 @@ def multi_error(score, label_int, w, sum_w):
 
 @functools.partial(jax.jit, static_argnames=("ks", "num_queries"))
 def ndcg_at_k(score, label_int, query_id, query_start_of_row, label_gain,
-              discount_by_rank, *, ks: tuple, num_queries: int):
+              discount_by_rank, query_weight=None, *, ks: tuple,
+              num_queries: int):
     """NDCG@k for every k in `ks`, averaged over queries.
 
     query_id            [N] int32 — query of each row
     query_start_of_row  [N] int32 — first row index of that query
     label_gain          [G] f32   — gain table
     discount_by_rank    [N] f32   — 1/log2(2+rank) precomputed to max length
+    query_weight        [Q] f32 or None — per-query weights for the average
+                        (rank_metric.hpp:113-142 weighted branch)
     Returns [len(ks)] f32.
     """
     s = score.astype(jnp.float32)
@@ -148,16 +161,17 @@ def ndcg_at_k(score, label_int, query_id, query_start_of_row, label_gain,
             num_segments=num_queries)
         # all-zero-gain queries count as 1 (rank_metric.hpp convention)
         nd = jnp.where(maxdcg > 0, dcg / jnp.maximum(maxdcg, 1e-30), 1.0)
-        out.append(jnp.mean(nd))
+        out.append(_qw_mean(nd, query_weight))
     return jnp.stack(out)
 
 
 @functools.partial(jax.jit, static_argnames=("ks", "num_queries"))
-def map_at_k(score, label_pos, query_id, query_start_of_row, *, ks: tuple,
-             num_queries: int):
+def map_at_k(score, label_pos, query_id, query_start_of_row,
+             query_weight=None, *, ks: tuple, num_queries: int):
     """MAP@k (map_metric.hpp semantics as implemented by the host metric:
     AP@k = sum_{i<k, rel_i} prec@i / #rel@k, queries with no relevant doc
-    in the top k are skipped from the average)."""
+    in the top k are skipped from the average; query_weight [Q] weights
+    the per-query average, map_metric.hpp:113-133)."""
     s = score.astype(jnp.float32)
     n = s.shape[0]
     rel = label_pos.astype(jnp.float32)
@@ -183,5 +197,5 @@ def map_at_k(score, label_pos, query_id, query_start_of_row, *, ks: tuple,
             jnp.where(within, rel_sorted, 0.0), qid_sorted,
             num_segments=num_queries)
         ap = jnp.where(nrel > 0, ap_num / jnp.maximum(nrel, 1.0), 0.0)
-        out.append(jnp.sum(ap) / num_queries)
+        out.append(_qw_mean(ap, query_weight))
     return jnp.stack(out)

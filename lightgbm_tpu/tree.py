@@ -127,6 +127,13 @@ class Tree:
             v = X[active, f]
             thr = self.threshold[node[active]]
             dec = self.decision_type[node[active]]
+            # non-finite values on a categorical split always go RIGHT
+            # here, while training-time binning maps NaN to value 0
+            # (binning.py value_to_bin), which can land in category 0's
+            # bin — the reference has the same train/predict asymmetry
+            # (its raw predict casts NaN with static_cast<int>, tree.h:
+            # 217-241, never matching a category); we emulate it rather
+            # than diverge from reference predictions on NaN rows
             finite = np.isfinite(v)
             vi = np.where(finite, v, -1.0).astype(np.int64)
             go_left = np.where(dec == 0, v <= thr,

@@ -1,0 +1,69 @@
+"""On-chip sweep of the rounds learner's leaves-per-batch K and the
+histogram MXU dtype at the north-star shape.
+
+Round-3 shipped K=84 on a pass-count model ("model-predicted, not yet
+chip-measured"); this script replaces the prediction with measurement:
+each configuration runs bench.py in a SUBPROCESS (LGBT_LEAVES_PER_BATCH
+is read at import time) at the full 10.5M-row HIGGS shape and the
+steady-state s/iter lands in k_sweep_measured.json at the repo root.
+
+Run:  python scripts/run_k_sweep.py           (on the TPU chip)
+Env:  KSWEEP_ROWS / KSWEEP_ITERS to shrink for smoke runs.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ROWS = int(os.environ.get("KSWEEP_ROWS", 10_500_000))
+ITERS = int(os.environ.get("KSWEEP_ITERS", 12))
+KS = [int(k) for k in os.environ.get("KSWEEP_KS", "42,84,126").split(",")]
+DTYPES = os.environ.get("KSWEEP_DTYPES", "bfloat16").split(",")
+
+
+def run_one(k: int, dtype: str):
+    env = dict(os.environ)
+    env.update({
+        "LGBT_LEAVES_PER_BATCH": str(k),
+        "BENCH_HIST_DTYPE": dtype,
+        "BENCH_ROWS": str(ROWS),
+        "BENCH_ITERS": str(ITERS),
+        "BENCH_WARMUP": "2",
+    })
+    t0 = time.perf_counter()
+    r = subprocess.run([sys.executable, os.path.join(ROOT, "bench.py")],
+                       env=env, capture_output=True, text=True,
+                       timeout=3600)
+    wall = time.perf_counter() - t0
+    line = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else "{}"
+    try:
+        rec = json.loads(line)
+    except json.JSONDecodeError:
+        rec = {"error": r.stdout[-500:] + r.stderr[-500:]}
+    rec.update({"K": k, "hist_dtype": dtype, "subprocess_wall_s": round(wall, 1)})
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def main():
+    results = []
+    for dtype in DTYPES:
+        for k in KS:
+            results.append(run_one(k, dtype))
+    out = {
+        "rows": ROWS,
+        "timed_iters": ITERS,
+        "config": "gbdt 255 leaves, 255 bins (bench.py north-star shape)",
+        "results": results,
+    }
+    dest = os.path.join(ROOT, "k_sweep_measured.json")
+    with open(dest, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"wrote": dest}))
+
+
+if __name__ == "__main__":
+    main()

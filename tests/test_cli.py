@@ -71,3 +71,25 @@ def test_regression_example_conf(tmp_path):
         "num_trees=5", f"output_model={model}", "verbosity=-1",
     ])
     assert rc == 0 and model.exists()
+
+
+def test_predict_file_streaming_chunks_match_oneshot(tmp_path, binary_example):
+    """Chunked predict_file (predictor.hpp:80-159 pipelined-reader analog)
+    must produce byte-identical output to a whole-file pass."""
+    X, y, Xt, yt = binary_example
+    bst = lgb.Booster({"objective": "binary", "verbose": -1,
+                       "num_leaves": 15}, lgb.Dataset(X, y))
+    for _ in range(3):
+        bst.update()
+    data = tmp_path / "pred.tsv"
+    rows = ["\t".join([f"{yt[i]:g}"] + [f"{v:.8g}" for v in Xt[i]])
+            for i in range(len(yt))]
+    data.write_text("\n".join(rows) + "\n")
+    p = Predictor(bst)
+    out_small = tmp_path / "preds_small.txt"
+    out_big = tmp_path / "preds_big.txt"
+    p.predict_file(str(data), str(out_small), chunk_rows=37)
+    p.predict_file(str(data), str(out_big), chunk_rows=1 << 20)
+    assert out_small.read_text() == out_big.read_text()
+    np.testing.assert_allclose(np.loadtxt(out_small), bst.predict(Xt),
+                               rtol=1e-14)

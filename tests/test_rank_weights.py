@@ -1,0 +1,99 @@
+"""Query weights in ranking metrics (round-3 verdict Missing #5).
+
+The reference derives a per-query weight as the MEAN row weight over the
+query's rows (metadata.cpp:457-470 LoadQueryWeights) and averages NDCG/MAP
+per-query results by it (rank_metric.hpp:113-142, map_metric.hpp:113-133).
+Lambdarank itself consumes ROW weights (rank_objective.hpp:164-167), which
+objectives.py already applies.
+"""
+import numpy as np
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.dataset import Metadata
+from lightgbm_tpu.metrics import create_metric
+
+
+def make_meta(labels, sizes, row_weights=None):
+    md = Metadata(label=np.asarray(labels, np.float32))
+    md.set_query_from_sizes(np.asarray(sizes))
+    if row_weights is not None:
+        md.weights = np.asarray(row_weights, np.float32)
+    return md
+
+
+def test_query_weights_derivation():
+    md = make_meta([1, 0, 2, 1, 0], [2, 3], [1.0, 3.0, 2.0, 2.0, 5.0])
+    qw = md.query_weights
+    np.testing.assert_allclose(qw, [2.0, 3.0])     # means of (1,3), (2,2,5)
+    assert make_meta([1, 0], [2]).query_weights is None
+
+
+def _hand_ndcg_at_1(labels, scores, gains):
+    """Single-query NDCG@1 by hand."""
+    top = np.argmax(scores)
+    dcg = gains[labels[top]]
+    maxdcg = gains[max(labels)]
+    return dcg / maxdcg if maxdcg > 0 else 1.0
+
+
+def test_ndcg_query_weighted_hand_values():
+    # query A (2 rows): perfect ranking -> ndcg@1 = 1
+    # query B (2 rows): inverted ranking, labels (0, 2) -> ndcg@1 = 0
+    labels = [1, 0, 0, 2]
+    scores = np.array([0.9, 0.1, 0.8, 0.2])
+    cfg = Config(objective="lambdarank", ndcg_eval_at=[1])
+    gains = np.array([2.0 ** i - 1 for i in range(31)])
+    a = _hand_ndcg_at_1([1, 0], scores[:2], gains)
+    b = _hand_ndcg_at_1([0, 2], scores[2:], gains)
+    assert (a, b) == (1.0, 0.0)
+
+    # uniform: (1 + 0) / 2
+    md = make_meta(labels, [2, 2])
+    m = create_metric("ndcg", cfg)
+    m.init(md, 4)
+    assert abs(m.eval(scores)[0][1] - 0.5) < 1e-12
+
+    # weighted: qw = (mean(1,1), mean(3,3)) = (1, 3) -> (1*1 + 3*0) / 4
+    md = make_meta(labels, [2, 2], [1.0, 1.0, 3.0, 3.0])
+    m = create_metric("ndcg", cfg)
+    m.init(md, 4)
+    assert abs(m.eval(scores)[0][1] - 0.25) < 1e-12
+
+
+def test_map_query_weighted_hand_values():
+    # query A: relevant doc ranked first -> ap@1 = 1
+    # query B: irrelevant doc ranked first -> ap@1 = 0
+    labels = [1, 0, 0, 1]
+    scores = np.array([0.9, 0.1, 0.8, 0.2])
+    cfg = Config(objective="lambdarank", ndcg_eval_at=[1])
+
+    md = make_meta(labels, [2, 2])
+    m = create_metric("map", cfg)
+    m.init(md, 4)
+    assert abs(m.eval(scores)[0][1] - 0.5) < 1e-12
+
+    # qw = (2, 6) -> (2*1 + 6*0) / 8 = 0.25
+    md = make_meta(labels, [2, 2], [2.0, 2.0, 6.0, 6.0])
+    m = create_metric("map", cfg)
+    m.init(md, 4)
+    assert abs(m.eval(scores)[0][1] - 0.25) < 1e-12
+
+
+def test_weighted_rank_metrics_host_device_agree():
+    rng = np.random.RandomState(3)
+    sizes = [5, 3, 8, 4]
+    n = sum(sizes)
+    labels = rng.randint(0, 4, size=n)
+    scores = rng.randn(n)
+    weights = rng.uniform(0.5, 2.0, size=n)
+    cfg = Config(objective="lambdarank", ndcg_eval_at=[1, 3, 5])
+    for name in ("ndcg", "map"):
+        md = make_meta(labels, sizes, weights)
+        m = create_metric(name, cfg)
+        m.init(md, n)
+        host = m.eval(scores)
+        import jax.numpy as jnp
+        dev = m.eval_device(jnp.asarray(scores))
+        for (hn, hv), (dn, dv) in zip(host, dev):
+            assert hn == dn
+            np.testing.assert_allclose(hv, dv, rtol=2e-5), name
