@@ -190,13 +190,16 @@ def _iter_predict_chunks(data_path: str, has_header: bool, label_idx: int,
             raise ValueError(f"empty data file: {data_path}")
         if has_header:
             first = f.readline() or first
-    if _detect_format(first) == "libsvm":
+    fmt = _detect_format(first)
+    if fmt == "libsvm":
         X, _, _ = parse_text_file(data_path, has_header, label_idx)
         yield X
         return
     import pandas as pd
-    sep = "," if "," in first else r"\s+"
-    for ch in pd.read_csv(data_path, sep=sep,
+    # same fmt->sep mapping and '#'-comment handling as the one-shot
+    # np.loadtxt parser (dataset.py parse_text_file)
+    sep = "," if fmt == "csv" else r"\s+"
+    for ch in pd.read_csv(data_path, sep=sep, comment="#",
                           header=0 if has_header else None,
                           chunksize=chunk_rows, dtype=np.float64):
         arr = ch.to_numpy(dtype=np.float64)

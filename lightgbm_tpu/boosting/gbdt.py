@@ -144,8 +144,17 @@ class GBDT:
                 or not self.objective.boost_from_average):
             return
         # reference uses the plain label average for all objectives
-        init_score = float(np.asarray(self.train_set.metadata.label,
-                                      np.float64).mean())
+        lab = np.asarray(self.train_set.metadata.label, np.float64)
+        import jax
+        if jax.process_count() > 1:
+            # every rank must seed the SAME constant or the grown trees
+            # diverge — average over the GLOBAL label set (bit-exact f64
+            # gather: a f32 round here shifts every leaf value)
+            from ..distributed import allgather_f64
+            sums = allgather_f64(np.asarray([lab.sum(), float(len(lab))]))
+            init_score = float(sums[:, 0].sum() / max(sums[:, 1].sum(), 1.0))
+        else:
+            init_score = float(lab.mean())
         t = Tree(2)
         t.split(0, 0, NUMERICAL_DECISION, 0, 0, 0.0, init_score, init_score,
                 0, self.num_data, 1.0)
@@ -196,9 +205,14 @@ class GBDT:
             self._pending_stop = True
 
     def _can_pipeline(self) -> bool:
+        import jax
         return (self.K == 1
                 and hasattr(self.learner, "train_device")
-                and self.__class__.__name__ in ("GBDT", "GOSS"))
+                and self.__class__.__name__ in ("GBDT", "GOSS")
+                # multi-process training keeps the sync path: the
+                # pipelined device-side score update would need local
+                # shard extraction from the global leaf_id
+                and jax.process_count() == 1)
 
     def _train_one_iter_pipelined(self) -> bool:
         """Boosting iteration with a one-iteration-delayed tree fetch: the

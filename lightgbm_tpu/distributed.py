@@ -165,6 +165,22 @@ def local_row_slice(n: int) -> slice:
     return slice(min(rank * per, n), min((rank + 1) * per, n))
 
 
+def allgather_f64(arr) -> "np.ndarray":
+    """Process-allgather a float64 array BIT-EXACTLY.
+
+    jax with x64 disabled silently rounds float64 collective payloads to
+    float32 — enough to perturb bin boundaries and init scores in their
+    last ulps, which breaks the multi-process == single-process model
+    equality the data-parallel scheme promises.  uint32 words survive
+    the collective unchanged.  Returns [world, *arr.shape] float64."""
+    import numpy as np
+    from jax.experimental import multihost_utils
+    a = np.ascontiguousarray(np.asarray(arr, np.float64))
+    words = a.view(np.uint32)
+    out = np.asarray(multihost_utils.process_allgather(words))
+    return out.view(np.float64)
+
+
 def find_bin_mappers_distributed(local_sample, cfg, categorical=()):
     """Global BinMappers from per-process local samples.
 
@@ -193,7 +209,7 @@ def find_bin_mappers_distributed(local_sample, cfg, categorical=()):
     smax = int(sizes.max())
     padded = np.zeros((smax, local_sample.shape[1]), np.float64)
     padded[: len(local_sample)] = local_sample
-    gathered = multihost_utils.process_allgather(padded)  # [W, smax, F]
+    gathered = allgather_f64(padded)                      # [W, smax, F]
     flat = np.concatenate([gathered[w, : int(sizes[w])]
                            for w in range(gathered.shape[0])])
     cap = int(cfg.bin_construct_sample_cnt)

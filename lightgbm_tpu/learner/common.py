@@ -10,6 +10,70 @@ import numpy as np
 from ..config import Config
 
 
+class MultiHostRows:
+    """Row-block layout + assembly for multi-process data-parallel
+    training: the mesh "data" axis spans processes, each process owns one
+    contiguous row block (the loader's pre-partition contract,
+    dataset.py pre_partition; reference dataset_loader.cpp:554-659).
+
+    Every process pads its block to the same per-process length so the
+    global [Np] row axis tiles evenly over the axis devices; global
+    arrays are assembled with `jax.make_array_from_process_local_data`
+    (the multi-controller analog of the reference's implicit "my rows
+    are mine" layout — no data ever crosses hosts, only collectives).
+    """
+
+    def __init__(self, mesh, n_local: int):
+        import jax
+        from jax.experimental import multihost_utils
+        axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        dd = int(axes.get("data", 1))
+        self.world = jax.process_count()
+        if dd % self.world:
+            raise ValueError(
+                f"data axis ({dd}) must be divisible by the process count "
+                f"({self.world}) for multi-host training")
+        if int(axes.get("feature", 1)) > 1:
+            raise NotImplementedError(
+                "multi-host feature-parallel training is not supported; "
+                "use tree_learner=data")
+        self.local_dd = dd // self.world
+        ns = np.asarray(multihost_utils.process_allgather(
+            np.asarray([n_local], np.int64))).reshape(-1)
+        self.n_local = int(n_local)
+        per = int(ns.max())
+        self.per_proc = self.local_dd * int(math.ceil(
+            per / self.local_dd)) if per else self.local_dd
+        self.np_global = self.per_proc * self.world
+        self.n_global = int(ns.sum())
+        self.mesh = mesh
+
+    def pad_local(self, x: np.ndarray) -> np.ndarray:
+        """Zero-pad the last (row) axis of a LOCAL block to per_proc."""
+        pad = self.per_proc - x.shape[-1]
+        if pad == 0:
+            return x
+        widths = [(0, 0)] * (x.ndim - 1) + [(0, pad)]
+        return np.pad(x, widths)
+
+    def put_rows(self, x_local: np.ndarray, spec):
+        """Assemble the global row-sharded array from this process's
+        padded local block (shape [..., per_proc])."""
+        import jax
+        from jax.sharding import NamedSharding
+        return jax.make_array_from_process_local_data(
+            NamedSharding(self.mesh, spec), np.ascontiguousarray(x_local))
+
+    def local_rows(self, arr) -> np.ndarray:
+        """Extract this process's rows from a global row-sharded array
+        (last axis = rows), trimmed back to the unpadded local length."""
+        shards = sorted(
+            ((s.index[-1].start or 0, np.asarray(s.data))
+             for s in arr.addressable_shards), key=lambda t: t[0])
+        return np.concatenate([d for _, d in shards],
+                              axis=-1)[..., : self.n_local]
+
+
 def make_split_kw(cfg: Config) -> tuple:
     """Hashable (static-arg) split hyperparameters for ops.split.best_split
     (reference feature_histogram.hpp:281-300 gain math inputs)."""

@@ -410,20 +410,30 @@ class FusedTreeLearner:
             axes = {}
         self.dd = int(axes.get("data", 1))
         self.df = int(axes.get("feature", 1))
-        self.Np = int(self.dd * math.ceil(self.N / self.dd))
+        # multi-process world: this process holds only its row block;
+        # the global row axis is assembled per-process (MultiHostRows)
+        self.mh = None
+        if mesh is not None and jax.process_count() > 1:
+            from .common import MultiHostRows
+            self.mh = MultiHostRows(mesh, self.N)
+            self.Np = self.mh.np_global
+            self._local_np = self.mh.per_proc
+        else:
+            self.Np = int(self.dd * math.ceil(self.N / self.dd))
+            self._local_np = self.Np
         self.Fp = int(self.df * math.ceil(self.F / self.df))
 
         bins_np = dataset.bins.astype(np.int32)
-        if self.Fp > self.F or self.Np > self.N:
+        if self.Fp > self.F or self._local_np > self.N:
             bins_np = np.pad(bins_np, ((0, self.Fp - self.F),
-                                       (0, self.Np - self.N)))
+                                       (0, self._local_np - self.N)))
         nb = np.pad(dataset.num_bins.astype(np.int32),
                     (0, self.Fp - self.F), constant_values=1)
         ic = np.pad(dataset.is_categorical, (0, self.Fp - self.F))
         self._base_fmask = np.pad(np.ones(self.F, bool),
                                   (0, self.Fp - self.F))
         self._row_mask = np.pad(np.ones(self.N, np.float32),
-                                (0, self.Np - self.N))
+                                (0, self._local_np - self.N))
 
         cfg = config
         self.split_kw = make_split_kw(cfg)
@@ -461,10 +471,16 @@ class FusedTreeLearner:
             self._build = jax.jit(jax.shard_map(
                 fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                 check_vma=False))
-            self.bins_dev = jax.device_put(
-                jnp.asarray(bins_np), NamedSharding(mesh, P(fa, da)))
-        self.num_bins_dev = jnp.asarray(nb)
-        self.is_cat_dev = jnp.asarray(ic)
+            if self.mh is not None:
+                self.bins_dev = self.mh.put_rows(bins_np, P(fa, da))
+            else:
+                self.bins_dev = jax.device_put(
+                    jnp.asarray(bins_np), NamedSharding(mesh, P(fa, da)))
+        # replicated metadata stays HOST-side numpy in multi-process mode
+        # (jit replicates identical host values across processes; a
+        # committed single-device array would be rejected)
+        self.num_bins_dev = nb if self.mh is not None else jnp.asarray(nb)
+        self.is_cat_dev = ic if self.mh is not None else jnp.asarray(ic)
 
     @property
     def bins_t(self) -> jax.Array:
@@ -474,7 +490,7 @@ class FusedTreeLearner:
             self._bins_t = jnp.asarray(sentinel_bins_t(self.dataset))
         return self._bins_t
 
-    def _feature_mask(self) -> jax.Array:
+    def _feature_mask(self):
         frac = self.config.feature_fraction
         m = self._base_fmask.copy()
         if frac < 1.0:
@@ -483,9 +499,13 @@ class FusedTreeLearner:
             mm = np.zeros(self.Fp, bool)
             mm[sel] = True
             m &= mm
-        return jnp.asarray(m)
+        return m if self.mh is not None else jnp.asarray(m)
 
-    def _pad_rows(self, x: jax.Array) -> jax.Array:
+    def _pad_rows(self, x: jax.Array):
+        if self.mh is not None:
+            from jax.sharding import PartitionSpec as P
+            return self.mh.put_rows(
+                self.mh.pad_local(np.asarray(x, np.float32)), P("data"))
         if self.Np == self.N:
             return x
         return jnp.pad(x, (0, self.Np - self.N))
@@ -493,18 +513,30 @@ class FusedTreeLearner:
     def train(self, grad: jax.Array, hess: jax.Array,
               bag_idx: Optional[jax.Array] = None,
               bag_count: Optional[int] = None) -> Tuple[Tree, jax.Array]:
-        mask = jnp.asarray(self._row_mask)
-        if bag_idx is not None:
-            # bag_idx is padded with sentinel N, which IS in bounds when
-            # rows are padded (Np > N) — multiply by the base row mask so
-            # padding rows can never count
-            mask = jnp.zeros(self.Np, jnp.float32).at[bag_idx].set(
-                1.0, mode="drop") * mask
+        if self.mh is not None:
+            mask = self._row_mask
+            if bag_idx is not None:
+                m2 = np.zeros(self._local_np, np.float32)
+                bi = np.asarray(bag_idx)
+                m2[bi[bi < self.N]] = 1.0
+                mask = m2 * mask
+            from jax.sharding import PartitionSpec as P
+            mask = self.mh.put_rows(mask, P("data"))
+        else:
+            mask = jnp.asarray(self._row_mask)
+            if bag_idx is not None:
+                # bag_idx is padded with sentinel N, which IS in bounds
+                # when rows are padded (Np > N) — multiply by the base
+                # row mask so padding rows can never count
+                mask = jnp.zeros(self.Np, jnp.float32).at[bag_idx].set(
+                    1.0, mode="drop") * mask
         arrs, leaf_id = self._build(
             self.bins_dev, self._pad_rows(grad), self._pad_rows(hess), mask,
             self.num_bins_dev, self.is_cat_dev, self._feature_mask())
         tree = tree_arrays_to_host(arrs, self.dataset,
                                    self.config.num_leaves)
+        if self.mh is not None:
+            return tree, jnp.asarray(self.mh.local_rows(leaf_id))
         return tree, leaf_id[: self.N]
 
 
@@ -515,8 +547,13 @@ def make_mesh(tree_learner: str, num_machines: int = 0
     config.h:233; the topology/linker machinery of src/network is replaced
     by the mesh itself)."""
     devs = jax.devices()
-    n = num_machines if num_machines and num_machines > 1 else len(devs)
-    n = min(n, len(devs))
+    if jax.process_count() > 1:
+        # num_machines counts HOSTS (reference config.h:246); the mesh
+        # always spans every device of the multi-process world
+        n = len(devs)
+    else:
+        n = num_machines if num_machines and num_machines > 1 else len(devs)
+        n = min(n, len(devs))
     if n <= 1:
         return None
     devs = np.asarray(devs[:n])

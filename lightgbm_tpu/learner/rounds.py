@@ -89,6 +89,7 @@ def _psum(x, axis):
 
 def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
                       num_leaves: int, num_bins_padded: int, split_kw: tuple,
+                      max_num_bin: int = 0,
                       max_depth: int, min_data_in_leaf: int,
                       min_sum_hessian_in_leaf: float,
                       data_axis: Optional[str] = None,
@@ -140,7 +141,8 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
     lid0 = jnp.zeros(Nloc, jnp.int32)
     h0 = hist_multileaf_masked(binsf, lid0, gh8,
                                jnp.zeros(1, jnp.int32), num_bins_padded=B,
-                               backend=backend, input_dtype=input_dtype)
+                               backend=backend, input_dtype=input_dtype,
+                               max_num_bin=max_num_bin)
     hist0 = _psum(h0[0], data_axis)                     # [F, 3, B]
     sum_g = jnp.sum(hist0[0, 0, :])
     sum_h = jnp.sum(hist0[0, 1, :])
@@ -286,7 +288,8 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
                 slv = jnp.where(dk, sl, -1)                  # -1 = empty slot
                 h_small = hist_multileaf_masked(
                     binsf, leaf_id2, gh8, slv, num_bins_padded=B,
-                    backend=backend, input_dtype=input_dtype)
+                    backend=backend, input_dtype=input_dtype,
+                    max_num_bin=max_num_bin)
                 h_small = _psum(h_small, data_axis)          # [Kc, F, 3, B]
                 if cache_parent_hist:
                     h_large = leaf_hist2[pl_[s:s + Kc]] - h_small
@@ -294,7 +297,8 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
                     llv = jnp.where(dk, large_leaf[s:s + Kc], -1)
                     h_large = _psum(hist_multileaf_masked(
                         binsf, leaf_id2, gh8, llv, num_bins_padded=B,
-                        backend=backend, input_dtype=input_dtype), data_axis)
+                        backend=backend, input_dtype=input_dtype,
+                        max_num_bin=max_num_bin), data_axis)
                 rec_s = find_best_batch(h_small, small_sums[s:s + Kc])
                 rec_l = find_best_batch(h_large, large_sums[s:s + Kc])
                 sil = small_is_left[s:s + Kc, None]
@@ -352,13 +356,21 @@ class RoundsTreeLearner:
         else:
             axes = {}
         self.dd = int(axes.get("data", 1))
-        self.Np = int(self.dd * math.ceil(self.N / self.dd))
+        self.mh = None
+        if mesh is not None and jax.process_count() > 1:
+            from .common import MultiHostRows
+            self.mh = MultiHostRows(mesh, self.N)
+            self.Np = self.mh.np_global
+            self._local_np = self.mh.per_proc
+        else:
+            self.Np = int(self.dd * math.ceil(self.N / self.dd))
+            self._local_np = self.Np
 
         bins_np = dataset.bins.astype(np.int32)
-        if self.Np > self.N:
-            bins_np = np.pad(bins_np, ((0, 0), (0, self.Np - self.N)))
+        if self._local_np > self.N:
+            bins_np = np.pad(bins_np, ((0, 0), (0, self._local_np - self.N)))
         self._row_mask = np.pad(np.ones(self.N, np.float32),
-                                (0, self.Np - self.N))
+                                (0, self._local_np - self.N))
         self._row_mask_dev = None     # lazy device cache (no bagging path)
         self._fmask_dev = None        # lazy device cache (no sampling path)
         self._base_fmask = np.ones(self.F, bool)
@@ -371,6 +383,7 @@ class RoundsTreeLearner:
         # feature count is this shard's local share
         self.cache_parent_hist = use_parent_hist_cache(cfg, self.F, self.B)
         kw = dict(num_leaves=cfg.num_leaves, num_bins_padded=self.B,
+                  max_num_bin=int(dataset.max_num_bin),
                   split_kw=self.split_kw, max_depth=int(cfg.max_depth),
                   min_data_in_leaf=int(cfg.min_data_in_leaf),
                   min_sum_hessian_in_leaf=float(cfg.min_sum_hessian_in_leaf),
@@ -391,10 +404,16 @@ class RoundsTreeLearner:
             self._build = jax.jit(jax.shard_map(
                 fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                 check_vma=False))
-            self.bins_dev = jax.device_put(
-                jnp.asarray(bins_np), NamedSharding(mesh, P(None, da)))
-        self.num_bins_dev = jnp.asarray(dataset.num_bins.astype(np.int32))
-        self.is_cat_dev = jnp.asarray(dataset.is_categorical)
+            if self.mh is not None:
+                self.bins_dev = self.mh.put_rows(bins_np, P(None, da))
+            else:
+                self.bins_dev = jax.device_put(
+                    jnp.asarray(bins_np), NamedSharding(mesh, P(None, da)))
+        # replicated metadata stays host numpy in multi-process mode
+        nbv = dataset.num_bins.astype(np.int32)
+        icv = np.asarray(dataset.is_categorical)
+        self.num_bins_dev = nbv if self.mh is not None else jnp.asarray(nbv)
+        self.is_cat_dev = icv if self.mh is not None else jnp.asarray(icv)
 
     @property
     def bins_t(self) -> jax.Array:
@@ -402,7 +421,7 @@ class RoundsTreeLearner:
             self._bins_t = jnp.asarray(sentinel_bins_t(self.dataset))
         return self._bins_t
 
-    def _feature_mask(self) -> jax.Array:
+    def _feature_mask(self):
         frac = self.config.feature_fraction
         m = self._base_fmask.copy()
         if frac < 1.0:
@@ -411,14 +430,31 @@ class RoundsTreeLearner:
             mm = np.zeros(self.F, bool)
             mm[sel] = True
             m &= mm
-        return jnp.asarray(m)
+        return m if self.mh is not None else jnp.asarray(m)
 
-    def _pad_rows(self, x: jax.Array) -> jax.Array:
+    def _pad_rows(self, x: jax.Array):
+        if self.mh is not None:
+            from jax.sharding import PartitionSpec as P
+            return self.mh.put_rows(
+                self.mh.pad_local(np.asarray(x, np.float32)), P("data"))
         if self.Np == self.N:
             return x
         return jnp.pad(x, (0, self.Np - self.N))
 
     def _masks(self, bag_idx):
+        if self.mh is not None:
+            from jax.sharding import PartitionSpec as P
+            mask = self._row_mask
+            if bag_idx is not None:
+                m2 = np.zeros(self._local_np, np.float32)
+                bi = np.asarray(bag_idx)
+                m2[bi[bi < self.N]] = 1.0
+                mask = m2 * mask
+            mask = self.mh.put_rows(mask, P("data"))
+            fmask = (self._feature_mask()
+                     if self.config.feature_fraction < 1.0
+                     else self._base_fmask)
+            return mask, fmask
         if self._row_mask_dev is None:
             self._row_mask_dev = jnp.asarray(self._row_mask)
         mask = self._row_mask_dev
@@ -454,4 +490,6 @@ class RoundsTreeLearner:
             self.bins_dev, self._pad_rows(grad), self._pad_rows(hess), mask,
             self.num_bins_dev, self.is_cat_dev, fmask)
         tree = tree_arrays_to_host(arrs, self.dataset, self.config.num_leaves)
+        if self.mh is not None:
+            return tree, jnp.asarray(self.mh.local_rows(leaf_id))
         return tree, leaf_id[: self.N]

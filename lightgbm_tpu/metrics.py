@@ -306,7 +306,7 @@ class NDCGMetric(Metric):
                             sizes)
             qstart = np.repeat(qb[:-1].astype(np.int32), sizes)
             label_gain, discount = _dcg_tables(self.config, self.num_data)
-            qw = self.metadata.query_weights
+            qw = self._host_qw()
             self._dev_rank_cache = (
                 jnp.asarray(qid), jnp.asarray(qstart),
                 jnp.asarray(label_gain.astype(np.float32)),

@@ -307,15 +307,36 @@ def hist_multileaf(gb_t: jax.Array, vals: jax.Array, *, num_bins_padded: int,
                               input_dtype=input_dtype)
 
 
+def _packed_onehot(gb_ref, g_, B, pack, bins_sub, out_dtype):
+    """One-hot block for `pack` features sharing the 128 lanes: feature
+    s of the pack occupies lanes [s·bins_sub, (s+1)·bins_sub), so ONE
+    [M, Ck] @ [Ck, B] matmul histograms all `pack` features — the fix
+    for the 2x bin-axis padding tax at max_bin<=63 (the reference GPU
+    sweet spot, docs/GPU-Performance.md:153-156): without packing a
+    64-bin histogram still pays full 128-lane MXU work."""
+    iota = jax.lax.broadcasted_iota(jnp.int32, (1, B), 1)
+    acc = None
+    for s in range(pack):
+        gb = gb_ref[0, g_ * pack + s, :]
+        cmp = (gb[:, None] + (s * bins_sub)) == iota
+        acc = cmp if acc is None else acc | cmp
+    if out_dtype == jnp.int8:
+        return acc.astype(jnp.int32).astype(jnp.int8)
+    return acc.astype(out_dtype)
+
+
 def _hist_kernel_masked(sl_ref, gb_ref, lid_ref, gh_ref, out_ref, *,
-                        B: int, K: int, input_dtype):
+                        B: int, K: int, input_dtype, pack: int = 1,
+                        bins_sub: int = 0):
     """Multi-leaf histogram with the leaf masks built in VMEM.
 
     sl_ref : [Kp, 128] int32 — small-leaf id per slot, replicated across
              lanes (-1 for empty slots, matches nothing)
     gb_ref : [1, G, Ck] int32 ; lid_ref: [1, Ck] int32 leaf id per row
     gh_ref : [8, Ck] f32 rows (grad·rm, hess·rm, rm, pad…)
-    out_ref: [1, G, Mp, B] f32 — rows [0:K)=grad, [K:2K)=hess, [2K:3K)=count
+    out_ref: [1, G/pack, Mp, B] f32 — rows [0:K)=grad, [K:2K)=hess,
+             [2K:3K)=count; with pack>1 each lane block holds `pack`
+             features' bins_sub-wide histograms side by side
 
     Fusing the mask construction here avoids materializing the [3K, N]
     values matrix in HBM per chunk (the XLA-level formulation round-trips
@@ -344,16 +365,15 @@ def _hist_kernel_masked(sl_ref, gb_ref, lid_ref, gh_ref, out_ref, *,
     prec = (jax.lax.Precision.HIGHEST if input_dtype == jnp.float32
             else jax.lax.Precision.DEFAULT)
     G = gb_ref.shape[1]
-    for g_ in range(G):
-        gb = gb_ref[0, g_, :]
-        oh = (gb[:, None] == jax.lax.broadcasted_iota(
-            jnp.int32, (1, B), 1)).astype(input_dtype)
+    for g_ in range(G // pack):
+        oh = _packed_onehot(gb_ref, g_, B, pack, bins_sub, input_dtype)
         out_ref[0, g_, :, :] += jnp.dot(
             vals, oh, preferred_element_type=jnp.float32, precision=prec)
 
 
 def _hist_kernel_masked_q(sl_ref, gb_ref, lid_ref, ghq_ref, out_ref, *,
-                          B: int, K: int):
+                          B: int, K: int, pack: int = 1,
+                          bins_sub: int = 0):
     """int8-quantized variant of _hist_kernel_masked: vals and one-hot
     are int8 and the contraction accumulates exactly in int32 (v5e runs
     int8 MXU matmuls at 2x bf16 throughput).  ghq rows are pre-quantized
@@ -372,21 +392,22 @@ def _hist_kernel_masked_q(sl_ref, gb_ref, lid_ref, ghq_ref, out_ref, *,
 
     lid = lid_ref[0, :]
     sl = sl_ref[:K, 0:1]
-    m = (lid[None, :] == sl).astype(jnp.int8)            # [K, Ck]
-    gq = ghq_ref[0:1, :].astype(jnp.int8)
-    hq = ghq_ref[1:2, :].astype(jnp.int8)
-    rm = ghq_ref[2:3, :].astype(jnp.int8)
-    vals = jnp.concatenate([m * gq, m * hq, m * rm], axis=0)  # [3K, Ck] i8
+    # elementwise mask work stays in i32 (Mosaic has neither int8
+    # 'arith.muli' nor an i1->(32,128)-tile relayout on this target);
+    # only the matmul OPERANDS are int8 — that is where the 2x
+    # throughput lives, and i32->i8 truncation is a supported cast
+    m = (lid[None, :] == sl).astype(jnp.int32)           # [K, Ck]
+    vals32 = jnp.concatenate([m * ghq_ref[0:1, :], m * ghq_ref[1:2, :],
+                              m * ghq_ref[2:3, :]], axis=0)  # [3K, Ck]
     Mp = out_ref.shape[2]
     if Mp > 3 * K:
-        vals = jnp.concatenate(
-            [vals, jnp.zeros((Mp - 3 * K, vals.shape[1]), jnp.int8)],
+        vals32 = jnp.concatenate(
+            [vals32, jnp.zeros((Mp - 3 * K, vals32.shape[1]), jnp.int32)],
             axis=0)
+    vals = vals32.astype(jnp.int8)
     G = gb_ref.shape[1]
-    for g_ in range(G):
-        gb = gb_ref[0, g_, :]
-        oh = (gb[:, None] == jax.lax.broadcasted_iota(
-            jnp.int32, (1, B), 1)).astype(jnp.int8)
+    for g_ in range(G // pack):
+        oh = _packed_onehot(gb_ref, g_, B, pack, bins_sub, jnp.int8)
         out_ref[0, g_, :, :] += jnp.dot(
             vals, oh, preferred_element_type=jnp.int32)
 
@@ -403,18 +424,37 @@ def _quantize_gh(gh8):
     return ghq, sg, sh
 
 
+def packed_bins_layout(max_num_bin: int, num_bins_padded: int):
+    """(bins_sub, pack) for the feature-packing optimization: when every
+    feature has <= 64 bins, `pack` features share one 128-lane block so
+    the one-hot matmul does no padded-lane work (docs/GPU-Performance.md
+    :153-156 — max_bin=63 is the accelerator sweet spot the reference
+    serves with a dedicated histogram64 kernel).  (0, 1) = no packing."""
+    if num_bins_padded != 128 or max_num_bin <= 0:
+        return 0, 1
+    for bs in (16, 32, 64):
+        if max_num_bin <= bs:
+            return bs, 128 // bs
+    return 0, 1
+
+
 @functools.partial(jax.jit, static_argnames=("num_bins_padded", "backend",
-                                             "input_dtype", "interpret"))
+                                             "input_dtype", "interpret",
+                                             "max_num_bin"))
 def hist_multileaf_masked(gb_t: jax.Array, lid: jax.Array, gh8: jax.Array,
                           sl: jax.Array, *, num_bins_padded: int,
                           backend: str = "xla",
                           input_dtype: str = "float32",
-                          interpret: bool = False) -> jax.Array:
+                          interpret: bool = False,
+                          max_num_bin: int = 0) -> jax.Array:
     """Histogram K leaves in one pass, masks built on the fly.
 
     gb_t: [F, C] int bins; lid: [C] int32 leaf ids; gh8: [8, C] f32
     (grad·rm, hess·rm, rm, pads); sl: [K] int32 leaf ids to histogram
     (-1 = empty slot).  Returns [K, F, 3, B] f32.
+
+    max_num_bin (static; 0 = unknown) enables feature packing on the
+    pallas path when all bins fit a 16/32/64-lane sub-block.
 
     input_dtype "int8" (EXPERIMENTAL, opt-in) selects per-pass symmetric
     gradient quantization with exact int32 accumulation: counts are
@@ -474,6 +514,8 @@ def hist_multileaf_masked(gb_t: jax.Array, lid: jax.Array, gh8: jax.Array,
     sl2 = jnp.broadcast_to(jnp.pad(sl, (0, Kp - K),
                                    constant_values=-1)[:, None], (Kp, 128))
     grid = (Fg // G, C // Ck)
+    bins_sub, pack = packed_bins_layout(max_num_bin, B)
+    Gp = G // pack
     in_specs = [
         pl.BlockSpec((Kp, 128), lambda f, k: (0, 0)),
         pl.BlockSpec((1, G, Ck), lambda f, k: (f, 0, k)),
@@ -481,32 +523,45 @@ def hist_multileaf_masked(gb_t: jax.Array, lid: jax.Array, gh8: jax.Array,
         pl.BlockSpec((8, Ck), lambda f, k: (0, k)),
     ]
 
+    def unpack(out):
+        """[Fg/G, G/pack, Mp, B] kernel output -> [F, Mp, B] with each
+        packed feature's bins_sub-wide histogram moved back to lanes
+        [0, bins_sub) and the bin axis zero-padded to B (bins >= the
+        sub-block width never occur, so zero is exact)."""
+        if pack == 1:
+            return out.reshape(Fg, Mp, B)[:F]
+        h = out.reshape(Fg // G, Gp, Mp, pack, bins_sub)
+        h = h.transpose(0, 1, 3, 2, 4).reshape(Fg, Mp, bins_sub)
+        return jnp.pad(h, ((0, 0), (0, 0), (0, B - bins_sub)))[:F]
+
     if quant:
         ghq, sg, sh = _quantize_gh(gh8)
         out = pl.pallas_call(
-            functools.partial(_hist_kernel_masked_q, B=B, K=K),
-            out_shape=jax.ShapeDtypeStruct((Fg // G, G, Mp, B), jnp.int32),
+            functools.partial(_hist_kernel_masked_q, B=B, K=K, pack=pack,
+                              bins_sub=bins_sub),
+            out_shape=jax.ShapeDtypeStruct((Fg // G, Gp, Mp, B), jnp.int32),
             grid=grid,
             in_specs=in_specs,
-            out_specs=pl.BlockSpec((1, G, Mp, B),
+            out_specs=pl.BlockSpec((1, Gp, Mp, B),
                                    lambda f, k: (f, 0, 0, 0)),
             interpret=interpret,
         )(sl2, gb_g, lid[None, :], ghq)
-        h = out.reshape(Fg, Mp, B)[:F].astype(jnp.float32)
+        h = unpack(out).astype(jnp.float32)
         return jnp.stack([h[:, :K] * sg, h[:, K:2 * K] * sh,
                           h[:, 2 * K:3 * K]],
                          axis=2).transpose(1, 0, 2, 3)
 
     dt = jnp.dtype(input_dtype)
     out = pl.pallas_call(
-        functools.partial(_hist_kernel_masked, B=B, K=K, input_dtype=dt),
-        out_shape=jax.ShapeDtypeStruct((Fg // G, G, Mp, B), jnp.float32),
+        functools.partial(_hist_kernel_masked, B=B, K=K, input_dtype=dt,
+                          pack=pack, bins_sub=bins_sub),
+        out_shape=jax.ShapeDtypeStruct((Fg // G, Gp, Mp, B), jnp.float32),
         grid=grid,
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, G, Mp, B), lambda f, k: (f, 0, 0, 0)),
+        out_specs=pl.BlockSpec((1, Gp, Mp, B), lambda f, k: (f, 0, 0, 0)),
         interpret=interpret,
     )(sl2, gb_g, lid[None, :], gh8)
-    h = out.reshape(Fg, Mp, B)[:F]                       # [F, Mp, B]
+    h = unpack(out)                                      # [F, Mp, B]
     return jnp.stack([h[:, :K], h[:, K:2 * K], h[:, 2 * K:3 * K]],
                      axis=2).transpose(1, 0, 2, 3)
 

@@ -38,11 +38,15 @@ def run_one(k: int, dtype: str):
                        env=env, capture_output=True, text=True,
                        timeout=3600)
     wall = time.perf_counter() - t0
-    line = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else "{}"
-    try:
-        rec = json.loads(line)
-    except json.JSONDecodeError:
-        rec = {"error": r.stdout[-500:] + r.stderr[-500:]}
+    line = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else ""
+    if r.returncode != 0 or not line:
+        rec = {"error": f"exit={r.returncode}: "
+                        + (r.stdout[-500:] + r.stderr[-500:]).strip()}
+    else:
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            rec = {"error": r.stdout[-500:] + r.stderr[-500:]}
     rec.update({"K": k, "hist_dtype": dtype, "subprocess_wall_s": round(wall, 1)})
     print(json.dumps(rec), flush=True)
     return rec

@@ -125,3 +125,57 @@ def test_hist_masked_int8_quantized_kernel():
     assert (np.abs(np.asarray(h_q)[:, :, 1] - np.asarray(h_f)[:, :, 1])
             <= bound_h).all()
 
+
+@pytest.mark.parametrize("max_nb,exp_pack", [(64, 2), (32, 4), (16, 8),
+                                             (33, 2), (65, 1)])
+def test_hist_masked_feature_packing(max_nb, exp_pack):
+    """Feature packing (<=64-bin features share a 128-lane block,
+    docs/GPU-Performance.md:153-156 sweet spot): the packed kernel must
+    equal the unpacked XLA path bin for bin, for every sub-block width."""
+    from lightgbm_tpu.ops.histogram import packed_bins_layout
+    bs, pack = packed_bins_layout(max_nb, 128)
+    assert pack == exp_pack
+    rng, gb = _rand(2500, 11, max_nb, seed=8)   # odd F: pad feature joins
+    B = 128                                     # a pack; must stay zero
+    K = 5
+    lid = rng.randint(0, 9, size=2500).astype(np.int32)
+    gh8 = np.zeros((8, 2500), np.float32)
+    gh8[0] = rng.randn(2500)
+    gh8[1] = rng.rand(2500)
+    gh8[2] = (rng.rand(2500) < 0.9)
+    gh8[0] *= gh8[2]
+    gh8[1] *= gh8[2]
+    sl = np.array([2, -1, 0, 8, 4], np.int32)
+    args = (jnp.asarray(gb), jnp.asarray(lid), jnp.asarray(gh8),
+            jnp.asarray(sl))
+    h_pk = hist_multileaf_masked(*args, num_bins_padded=B, backend="pallas",
+                                 input_dtype="float32", interpret=True,
+                                 max_num_bin=max_nb)
+    h_x = hist_multileaf_masked(*args, num_bins_padded=B, backend="xla",
+                                input_dtype="float32")
+    assert h_pk.shape == h_x.shape == (K, 11, 3, B)
+    np.testing.assert_allclose(np.asarray(h_pk), np.asarray(h_x),
+                               rtol=0, atol=1e-4)
+    if pack > 1:
+        # lanes past the sub-block width must be exactly zero
+        assert np.asarray(h_pk)[:, :, :, bs:].max() == 0.0
+
+
+def test_hist_masked_int8_feature_packing():
+    rng, gb = _rand(2000, 5, 60, seed=9)
+    B = 128
+    lid = rng.randint(0, 6, size=2000).astype(np.int32)
+    gh8 = np.zeros((8, 2000), np.float32)
+    gh8[0] = rng.randn(2000)
+    gh8[1] = rng.rand(2000)
+    gh8[2] = 1.0
+    sl = np.array([1, 4, -1], np.int32)
+    args = (jnp.asarray(gb), jnp.asarray(lid), jnp.asarray(gh8),
+            jnp.asarray(sl))
+    h_q = hist_multileaf_masked(*args, num_bins_padded=B, backend="pallas",
+                                input_dtype="int8", interpret=True,
+                                max_num_bin=64)
+    h_qx = hist_multileaf_masked(*args, num_bins_padded=B, backend="xla",
+                                 input_dtype="int8")
+    np.testing.assert_allclose(np.asarray(h_q), np.asarray(h_qx),
+                               rtol=0, atol=1e-4)
