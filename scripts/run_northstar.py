@@ -25,6 +25,7 @@ ROWS = int(os.environ.get("NS_ROWS", 10_500_000))
 TEST_ROWS = int(os.environ.get("NS_TEST_ROWS", 500_000))
 ITERS = int(os.environ.get("NS_ITERS", 500))
 EVAL_FREQ = int(os.environ.get("NS_EVAL_FREQ", 25))
+HIST_DTYPE = os.environ.get("NS_HIST_DTYPE", "bfloat16")
 
 
 def main():
@@ -41,7 +42,7 @@ def main():
         "objective": "binary", "metric": "auc", "verbose": -1,
         "num_leaves": 255, "learning_rate": 0.1, "max_bin": 255,
         "min_data_in_leaf": 1, "min_sum_hessian_in_leaf": 100.0,
-        "histogram_dtype": "bfloat16",
+        "histogram_dtype": HIST_DTYPE,
     }
     # binning happens here, OUTSIDE the training wall-clock — the same
     # accounting as the reference log, whose 89s data load is separate
@@ -75,11 +76,22 @@ def main():
     # comparisons against the reference are only meaningful at the FULL
     # north-star shape; smoke runs must not emit full-scale claims
     at_full_shape = (ROWS == 10_500_000 and ITERS == 500)
+    import subprocess
+    try:
+        # --dirty: an artifact stamped from a modified tree must say so
+        head = subprocess.run(["git", "describe", "--always", "--dirty"],
+                              cwd=ROOT, capture_output=True,
+                              text=True).stdout.strip() or "unknown"
+    except OSError:
+        head = "unknown"
     out = {
-        "workload": (base.get("workload")
+        "workload": ((base.get("workload", "")
+                      + f" [histogram_dtype={HIST_DTYPE}]")
                      if at_full_shape else
                      f"SMOKE RUN {ROWS}x28 synthetic higgs, {ITERS} iters "
                      "- not comparable to the reference baseline"),
+        "measured_at_commit": head,
+        "histogram_dtype": HIST_DTYPE,
         "backend": backend,
         "rows": ROWS, "iters": ITERS,
         "data_gen_seconds": round(t_gen, 1),

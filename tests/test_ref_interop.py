@@ -1,0 +1,67 @@
+"""Cross-implementation model interop against the REFERENCE BINARY
+(round-3 verdict Weak #7 / ask #5): a lightgbm_tpu model text must score
+identically through the reference CLI, and a reference-trained model must
+load and score identically here.  Model-text contract: gbdt.cpp:694-848,
+tree.cpp:295+.
+
+Skips cleanly when the compiled reference binary is absent (build recipe:
+scripts/make_baseline.py docstring → .bench/lightgbm).
+"""
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.dataset import parse_text_file
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REF_BIN = os.path.join(ROOT, ".bench", "lightgbm")
+EX = "/root/reference/examples/binary_classification"
+
+pytestmark = pytest.mark.skipif(
+    not (os.path.exists(REF_BIN) and os.path.isdir(EX)),
+    reason="reference binary not built (scripts/make_baseline.py) "
+           "or reference example data absent")
+
+
+def _run_ref(workdir, *kv):
+    r = subprocess.run([REF_BIN, *kv], cwd=str(workdir),
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    return r
+
+
+def test_our_model_scores_identically_through_reference(tmp_path):
+    X, y, _ = parse_text_file(f"{EX}/binary.train")
+    Xt, _, _ = parse_text_file(f"{EX}/binary.test")
+    bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                     "min_data_in_leaf": 20, "verbose": -1},
+                    lgb.Dataset(X, y), num_boost_round=10)
+    model = tmp_path / "ours.txt"
+    bst.save_model(str(model))
+    out = tmp_path / "ref_preds.txt"
+    _run_ref(tmp_path, "task=predict", f"data={EX}/binary.test",
+             f"input_model={model}", f"output_result={out}")
+    ref_preds = np.loadtxt(out)
+    ours = bst.predict(Xt)
+    # the reference walks raw feature values through the same tree text;
+    # scores agree to float print precision
+    np.testing.assert_allclose(ref_preds, ours, rtol=1e-6, atol=1e-9)
+
+
+def test_reference_model_loads_and_scores_identically(tmp_path):
+    model = tmp_path / "ref_model.txt"
+    _run_ref(tmp_path, "task=train", f"data={EX}/binary.train",
+             "objective=binary", "num_trees=10", "num_leaves=31",
+             "min_data_in_leaf=20", f"output_model={model}",
+             "verbosity=-1")
+    out = tmp_path / "ref_preds.txt"
+    _run_ref(tmp_path, "task=predict", f"data={EX}/binary.test",
+             f"input_model={model}", f"output_result={out}")
+    ref_preds = np.loadtxt(out)
+
+    Xt, _, _ = parse_text_file(f"{EX}/binary.test")
+    ours = lgb.Booster(model_file=str(model)).predict(Xt)
+    np.testing.assert_allclose(ours, ref_preds, rtol=1e-6, atol=1e-9)
