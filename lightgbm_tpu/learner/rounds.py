@@ -118,7 +118,15 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
     R = max_rounds if max_rounds > 0 else L - 1
     skw = dict(split_kw)
     l1, l2 = skw["lambda_l1"], skw["lambda_l2"]
-    binsf = bins.astype(jnp.int32)
+    # int8-stored bins (value-128, see ops/histogram bin_offset) stay
+    # narrow: a [F, N] int32 copy would be 4x the HBM (30.8 GB at Expo
+    # shape); every consumer widens in fused ops / kernel VMEM
+    if bins.dtype == jnp.int8:
+        binsf = bins
+        bin_off = 128
+    else:
+        binsf = bins.astype(jnp.int32)
+        bin_off = 0
 
     def find_best_batch(hists, sums):
         """hists [K2, F, 3, B], sums [K2, 3] → packed recs [K2, 11] with the
@@ -223,7 +231,9 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
         ti = r[1].astype(jnp.int32)
         ci = r[2] > 0
         nli = r[3].astype(jnp.int32)
-        vi = select_bin_by_feature(binsf, fi)
+        # every row matches exactly one feature, so the stored-offset
+        # correction is a single +128 on the selected value
+        vi = select_bin_by_feature(binsf, fi) + bin_off
         gl = jnp.where(ci, vi == ti, vi <= ti)
         leaf_id2 = jnp.where((nli > 0) & ~gl, nli, leaf_id)
 
@@ -275,6 +285,36 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
         small_sums = jnp.where(small_is_left[:, None], l_sums, r_sums)
         large_sums = jnp.where(small_is_left[:, None], r_sums, l_sums)
 
+        # early rounds have few splittable leaves (1, 2, 4, ... for a
+        # balanced tree) but a fixed-K pass pays the full Mp=3K matmul
+        # M dimension for mostly-empty slots — a two-tier kernel cuts
+        # the first rounds' MXU work ~8x: when a chunk has <= K_SMALL
+        # active slots, histogram through the small-K kernel and
+        # zero-pad the result (inactive slots are dropped downstream
+        # anyway, so the padding rows are never read)
+        K_SMALL = min(8, K)
+
+        def hist_tiered(slv, dk, Kc):
+            full_call = functools.partial(
+                hist_multileaf_masked, num_bins_padded=B, backend=backend,
+                input_dtype=input_dtype, max_num_bin=max_num_bin)
+            if Kc <= K_SMALL:
+                return full_call(binsf, leaf_id2, gh8, slv)
+
+            def small(_):
+                h = full_call(binsf, leaf_id2, gh8, slv[:K_SMALL])
+                return jnp.concatenate(
+                    [h, jnp.zeros((Kc - K_SMALL,) + h.shape[1:],
+                                  h.dtype)], axis=0)
+
+            def full(_):
+                return full_call(binsf, leaf_id2, gh8, slv)
+
+            # gate on the REAL precondition (no active slot past the
+            # small window), not on the count — robust even if the
+            # sorted-prefix layout of `do` ever changes
+            return jax.lax.cond(~jnp.any(dk[K_SMALL:]), small, full, None)
+
         leaf_best2 = leaf_best
         leaf_hist2 = leaf_hist
         for c in range(n_chunks):
@@ -286,19 +326,13 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
             def do_chunk(args, s=s, Kc=Kc, dk=dk, sl=sl):
                 leaf_best2, leaf_hist2 = args
                 slv = jnp.where(dk, sl, -1)                  # -1 = empty slot
-                h_small = hist_multileaf_masked(
-                    binsf, leaf_id2, gh8, slv, num_bins_padded=B,
-                    backend=backend, input_dtype=input_dtype,
-                    max_num_bin=max_num_bin)
+                h_small = hist_tiered(slv, dk, Kc)
                 h_small = _psum(h_small, data_axis)          # [Kc, F, 3, B]
                 if cache_parent_hist:
                     h_large = leaf_hist2[pl_[s:s + Kc]] - h_small
                 else:
                     llv = jnp.where(dk, large_leaf[s:s + Kc], -1)
-                    h_large = _psum(hist_multileaf_masked(
-                        binsf, leaf_id2, gh8, llv, num_bins_padded=B,
-                        backend=backend, input_dtype=input_dtype,
-                        max_num_bin=max_num_bin), data_axis)
+                    h_large = _psum(hist_tiered(llv, dk, Kc), data_axis)
                 rec_s = find_best_batch(h_small, small_sums[s:s + Kc])
                 rec_l = find_best_batch(h_large, large_sums[s:s + Kc])
                 sil = small_is_left[s:s + Kc, None]
@@ -366,22 +400,48 @@ class RoundsTreeLearner:
             self.Np = int(self.dd * math.ceil(self.N / self.dd))
             self._local_np = self.Np
 
-        bins_np = dataset.bins.astype(np.int32)
+        backend = ("pallas" if jax.default_backend() == "tpu" else "xla")
+        nbv = dataset.num_bins.astype(np.int32)
+        icv = np.asarray(dataset.is_categorical)
+        if backend == "pallas" and dataset.max_num_bin <= 256:
+            # int8 HBM layout (value - 128): 4x less device memory and
+            # bandwidth than int32 — what fits Expo's 11M x 700 store
+            # (7.7 GB vs 30.8 GB) on one v5e chip
+            bins_np = (dataset.bins.astype(np.int16) - 128).astype(np.int8)
+            # pad features to the int8 kernel's 32-sublane group on the
+            # HOST: a device-side pad would briefly hold a second full
+            # copy of the bins array.  Padded features are trivial
+            # (1 bin, fmask False) and can never be selected.
+            self.Fpad = 32 * int(math.ceil(self.F / 32))
+        else:
+            bins_np = dataset.bins.astype(np.int32)
+            self.Fpad = self.F
+        # pad value must be an in-range bin; padded rows/features carry
+        # zero mask so their bin never matters
+        pad_val = -128 if bins_np.dtype == np.int8 else 0
+        if self.Fpad > self.F:
+            fp = self.Fpad - self.F
+            bins_np = np.pad(bins_np, ((0, fp), (0, 0)),
+                             constant_values=pad_val)
+            nbv = np.pad(nbv, (0, fp), constant_values=1)
+            icv = np.pad(icv, (0, fp))
         if self._local_np > self.N:
-            bins_np = np.pad(bins_np, ((0, 0), (0, self._local_np - self.N)))
+            bins_np = np.pad(bins_np, ((0, 0), (0, self._local_np - self.N)),
+                             constant_values=pad_val)
         self._row_mask = np.pad(np.ones(self.N, np.float32),
                                 (0, self._local_np - self.N))
         self._row_mask_dev = None     # lazy device cache (no bagging path)
         self._fmask_dev = None        # lazy device cache (no sampling path)
-        self._base_fmask = np.ones(self.F, bool)
+        self._base_fmask = np.pad(np.ones(self.F, bool),
+                                  (0, self.Fpad - self.F))
         cfg = config
         self.split_kw = make_split_kw(cfg)
         self._feat_rng = np.random.RandomState(cfg.feature_fraction_seed)
-        backend = ("pallas" if jax.default_backend() == "tpu" else "xla")
 
         # histogram-memory bound (reference HistogramPool analog); the
         # feature count is this shard's local share
-        self.cache_parent_hist = use_parent_hist_cache(cfg, self.F, self.B)
+        self.cache_parent_hist = use_parent_hist_cache(cfg, self.Fpad,
+                                                       self.B)
         kw = dict(num_leaves=cfg.num_leaves, num_bins_padded=self.B,
                   max_num_bin=int(dataset.max_num_bin),
                   split_kw=self.split_kw, max_depth=int(cfg.max_depth),
@@ -410,8 +470,7 @@ class RoundsTreeLearner:
                 self.bins_dev = jax.device_put(
                     jnp.asarray(bins_np), NamedSharding(mesh, P(None, da)))
         # replicated metadata stays host numpy in multi-process mode
-        nbv = dataset.num_bins.astype(np.int32)
-        icv = np.asarray(dataset.is_categorical)
+        # (nbv/icv already carry the int8 feature padding)
         self.num_bins_dev = nbv if self.mh is not None else jnp.asarray(nbv)
         self.is_cat_dev = icv if self.mh is not None else jnp.asarray(icv)
 
@@ -425,9 +484,11 @@ class RoundsTreeLearner:
         frac = self.config.feature_fraction
         m = self._base_fmask.copy()
         if frac < 1.0:
+            # sampling draws from the REAL features; int8-alignment
+            # padding features stay masked out
             k = max(1, int(round(self.F * frac)))
             sel = self._feat_rng.choice(self.F, size=k, replace=False)
-            mm = np.zeros(self.F, bool)
+            mm = np.zeros(self.Fpad, bool)
             mm[sel] = True
             m &= mm
         return m if self.mh is not None else jnp.asarray(m)

@@ -307,17 +307,22 @@ def hist_multileaf(gb_t: jax.Array, vals: jax.Array, *, num_bins_padded: int,
                               input_dtype=input_dtype)
 
 
-def _packed_onehot(gb_ref, g_, B, pack, bins_sub, out_dtype):
+def _packed_onehot(gb_ref, g_, B, pack, bins_sub, out_dtype,
+                   bin_offset=0):
     """One-hot block for `pack` features sharing the 128 lanes: feature
     s of the pack occupies lanes [s·bins_sub, (s+1)·bins_sub), so ONE
     [M, Ck] @ [Ck, B] matmul histograms all `pack` features — the fix
     for the 2x bin-axis padding tax at max_bin<=63 (the reference GPU
     sweet spot, docs/GPU-Performance.md:153-156): without packing a
-    64-bin histogram still pays full 128-lane MXU work."""
+    64-bin histogram still pays full 128-lane MXU work.
+
+    bin_offset: bins may arrive stored as int8 `bin - 128` (the HBM
+    layout that fits Expo-scale 11M x 700 on one chip); the widen +
+    un-offset runs here in VMEM, never materializing wide bins."""
     iota = jax.lax.broadcasted_iota(jnp.int32, (1, B), 1)
     acc = None
     for s in range(pack):
-        gb = gb_ref[0, g_ * pack + s, :]
+        gb = gb_ref[0, g_ * pack + s, :].astype(jnp.int32) + bin_offset
         cmp = (gb[:, None] + (s * bins_sub)) == iota
         acc = cmp if acc is None else acc | cmp
     if out_dtype == jnp.int8:
@@ -327,12 +332,14 @@ def _packed_onehot(gb_ref, g_, B, pack, bins_sub, out_dtype):
 
 def _hist_kernel_masked(sl_ref, gb_ref, lid_ref, gh_ref, out_ref, *,
                         B: int, K: int, input_dtype, pack: int = 1,
-                        bins_sub: int = 0):
+                        bins_sub: int = 0, bin_offset: int = 0):
     """Multi-leaf histogram with the leaf masks built in VMEM.
 
     sl_ref : [Kp, 128] int32 — small-leaf id per slot, replicated across
              lanes (-1 for empty slots, matches nothing)
-    gb_ref : [1, G, Ck] int32 ; lid_ref: [1, Ck] int32 leaf id per row
+    gb_ref : [1, G, Ck] int32, or int8 holding value-128 when
+             bin_offset=128 (widened per feature row in _packed_onehot)
+    lid_ref: [1, Ck] int32 leaf id per row
     gh_ref : [8, Ck] f32 rows (grad·rm, hess·rm, rm, pad…)
     out_ref: [1, G/pack, Mp, B] f32 — rows [0:K)=grad, [K:2K)=hess,
              [2K:3K)=count; with pack>1 each lane block holds `pack`
@@ -366,14 +373,15 @@ def _hist_kernel_masked(sl_ref, gb_ref, lid_ref, gh_ref, out_ref, *,
             else jax.lax.Precision.DEFAULT)
     G = gb_ref.shape[1]
     for g_ in range(G // pack):
-        oh = _packed_onehot(gb_ref, g_, B, pack, bins_sub, input_dtype)
+        oh = _packed_onehot(gb_ref, g_, B, pack, bins_sub, input_dtype,
+                            bin_offset)
         out_ref[0, g_, :, :] += jnp.dot(
             vals, oh, preferred_element_type=jnp.float32, precision=prec)
 
 
 def _hist_kernel_masked_q(sl_ref, gb_ref, lid_ref, ghq_ref, out_ref, *,
                           B: int, K: int, pack: int = 1,
-                          bins_sub: int = 0):
+                          bins_sub: int = 0, bin_offset: int = 0):
     """int8-quantized variant of _hist_kernel_masked: vals and one-hot
     are int8 and the contraction accumulates exactly in int32 (v5e runs
     int8 MXU matmuls at 2x bf16 throughput).  ghq rows are pre-quantized
@@ -407,7 +415,8 @@ def _hist_kernel_masked_q(sl_ref, gb_ref, lid_ref, ghq_ref, out_ref, *,
     vals = vals32.astype(jnp.int8)
     G = gb_ref.shape[1]
     for g_ in range(G // pack):
-        oh = _packed_onehot(gb_ref, g_, B, pack, bins_sub, jnp.int8)
+        oh = _packed_onehot(gb_ref, g_, B, pack, bins_sub, jnp.int8,
+                            bin_offset)
         out_ref[0, g_, :, :] += jnp.dot(
             vals, oh, preferred_element_type=jnp.int32)
 
@@ -469,6 +478,10 @@ def hist_multileaf_masked(gb_t: jax.Array, lid: jax.Array, gh8: jax.Array,
     K = sl.shape[0]
     B = num_bins_padded
     quant = input_dtype == "int8"
+    # int8-STORED bins (value - 128): the HBM layout that fits wide
+    # datasets (Expo 11M x 700 = 7.7 GB instead of 30.8 GB int32); the
+    # pallas path widens blocks in VMEM, the XLA path fuses the widen
+    bin_offset = 128 if gb_t.dtype == jnp.int8 else 0
     # int32-accumulator safety: with constant hessians every row
     # quantizes to exactly 127, so one bin can accumulate 127*C — keep
     # 127*C < 2^31 (and per-bin counts < 2^24 so the f32 conversion
@@ -482,6 +495,8 @@ def hist_multileaf_masked(gb_t: jax.Array, lid: jax.Array, gh8: jax.Array,
         input_dtype = "bfloat16"
 
     if backend != "pallas":
+        if bin_offset:
+            gb_t = gb_t.astype(jnp.int32) + bin_offset
         if quant:
             ghq, sg, sh = _quantize_gh(gh8)
             gh8 = jnp.concatenate([
@@ -497,7 +512,9 @@ def hist_multileaf_masked(gb_t: jax.Array, lid: jax.Array, gh8: jax.Array,
         return jnp.stack([h[:, :K], h[:, K:2 * K], h[:, 2 * K:3 * K]],
                          axis=2).transpose(1, 0, 2, 3)
 
-    G = FEATURE_GROUP
+    # int8 bins keep their narrow dtype into the kernel; the int8 VMEM
+    # tile is (32, 128), so the feature-group sublane dim grows to 32
+    G = 32 if bin_offset else FEATURE_GROUP
     Ck = min(C, HIST_CHUNK)
     if C % Ck:
         pad = Ck - C % Ck
@@ -508,7 +525,9 @@ def hist_multileaf_masked(gb_t: jax.Array, lid: jax.Array, gh8: jax.Array,
     Fg = G * ((F + G - 1) // G)
     if Fg > F:
         gb_t = jnp.pad(gb_t, ((0, Fg - F), (0, 0)))
-    gb_g = gb_t.reshape(Fg // G, G, C).astype(jnp.int32)
+    gb_g = gb_t.reshape(Fg // G, G, C)
+    if not bin_offset:
+        gb_g = gb_g.astype(jnp.int32)
     Mp = 8 * ((3 * K + 7) // 8)
     Kp = 8 * ((K + 7) // 8)
     sl2 = jnp.broadcast_to(jnp.pad(sl, (0, Kp - K),
@@ -538,7 +557,7 @@ def hist_multileaf_masked(gb_t: jax.Array, lid: jax.Array, gh8: jax.Array,
         ghq, sg, sh = _quantize_gh(gh8)
         out = pl.pallas_call(
             functools.partial(_hist_kernel_masked_q, B=B, K=K, pack=pack,
-                              bins_sub=bins_sub),
+                              bins_sub=bins_sub, bin_offset=bin_offset),
             out_shape=jax.ShapeDtypeStruct((Fg // G, Gp, Mp, B), jnp.int32),
             grid=grid,
             in_specs=in_specs,
@@ -554,7 +573,8 @@ def hist_multileaf_masked(gb_t: jax.Array, lid: jax.Array, gh8: jax.Array,
     dt = jnp.dtype(input_dtype)
     out = pl.pallas_call(
         functools.partial(_hist_kernel_masked, B=B, K=K, input_dtype=dt,
-                          pack=pack, bins_sub=bins_sub),
+                          pack=pack, bins_sub=bins_sub,
+                          bin_offset=bin_offset),
         out_shape=jax.ShapeDtypeStruct((Fg // G, Gp, Mp, B), jnp.float32),
         grid=grid,
         in_specs=in_specs,

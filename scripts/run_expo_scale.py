@@ -19,21 +19,30 @@ import numpy as np
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
-ROWS = int(os.environ.get("EXPO_ROWS", 2_000_000))
+ROWS = int(os.environ.get("EXPO_ROWS", 11_000_000))
 ITERS = int(os.environ.get("EXPO_ITERS", 30))
 WARMUP = 3
-F = 100
+F = int(os.environ.get("EXPO_FEATURES", 700))
 NCAT = 64
 
 
 def synth_expo(n, f=F, seed=11):
+    """Full Expo shape (docs/GPU-Performance.md:77-84: 11M x 700 raw
+    categorical).  Column-blocked generation: a [n, f] float64 matrix
+    plus int64 indexing transients would need ~130 GB; float32 storage
+    + per-column accumulation stays ~31 GB (category ids <= 64 are
+    exact in f32)."""
     rng = np.random.RandomState(seed)
     # skewed category frequencies (zipf-ish), like carrier/airport codes
     p = 1.0 / np.arange(1, NCAT + 1)
     p /= p.sum()
-    X = rng.choice(NCAT, size=(n, f), p=p).astype(np.float64)
+    X = np.empty((n, f), np.float32)
+    logits = np.zeros(n, np.float64)
     beta = np.random.RandomState(50).randn(f, NCAT) * 0.3
-    logits = beta[np.arange(f)[None, :], X.astype(np.int64)].sum(axis=1)
+    for j in range(f):
+        col = rng.choice(NCAT, size=n, p=p)
+        X[:, j] = col
+        logits += beta[j, col]
     y = (logits + rng.logistic(size=n) > 0).astype(np.float64)
     return X, y
 

@@ -179,3 +179,39 @@ def test_hist_masked_int8_feature_packing():
                                  input_dtype="int8")
     np.testing.assert_allclose(np.asarray(h_q), np.asarray(h_qx),
                                rtol=0, atol=1e-4)
+
+
+@pytest.mark.parametrize("input_dtype", ["float32", "int8"])
+def test_hist_masked_int8_stored_bins(input_dtype):
+    """int8-STORED bins (value-128 HBM layout, the Expo-scale memory fix)
+    must histogram identically to int32 storage, through both the f32/bf16
+    kernel and the quantized kernel, including the G=32 block regrouping."""
+    rng, gb = _rand(3000, 37, 250, seed=12)     # F=37: pads to 64 at G=32
+    B = 256
+    K = 5
+    lid = rng.randint(0, 9, size=3000).astype(np.int32)
+    gh8 = np.zeros((8, 3000), np.float32)
+    gh8[0] = rng.randn(3000)
+    gh8[1] = rng.rand(3000)
+    gh8[2] = (rng.rand(3000) < 0.9)
+    gh8[0] *= gh8[2]
+    gh8[1] *= gh8[2]
+    sl = np.array([2, -1, 0, 8, 4], np.int32)
+    gb8 = (gb.astype(np.int16) - 128).astype(np.int8)
+    h_i8 = hist_multileaf_masked(
+        jnp.asarray(gb8), jnp.asarray(lid), jnp.asarray(gh8),
+        jnp.asarray(sl), num_bins_padded=B, backend="pallas",
+        input_dtype=input_dtype, interpret=True)
+    h_i32 = hist_multileaf_masked(
+        jnp.asarray(gb), jnp.asarray(lid), jnp.asarray(gh8),
+        jnp.asarray(sl), num_bins_padded=B, backend="xla",
+        input_dtype=input_dtype)
+    np.testing.assert_allclose(np.asarray(h_i8), np.asarray(h_i32),
+                               rtol=0, atol=1e-4)
+    # XLA fallback accepts the int8 storage too
+    h_i8x = hist_multileaf_masked(
+        jnp.asarray(gb8), jnp.asarray(lid), jnp.asarray(gh8),
+        jnp.asarray(sl), num_bins_padded=B, backend="xla",
+        input_dtype=input_dtype)
+    np.testing.assert_allclose(np.asarray(h_i8x), np.asarray(h_i32),
+                               rtol=0, atol=1e-4)

@@ -133,3 +133,62 @@ def test_leaves_per_batch_k_independent(monkeypatch):
     assert n_small == n_default
     np.testing.assert_allclose(p_small, p_default, atol=2e-3)
     assert np.mean(np.abs(p_small - p_default) < 1e-6) > 0.95
+
+
+def test_int8_stored_bins_grow_identical_trees():
+    """The int8 value-128 HBM layout (chosen on TPU, rounds.py __init__)
+    must grow the SAME TreeArrays as int32 storage through the XLA path
+    — exercises the learner-level wiring (feature padding to the 32-
+    sublane group, padded nbv/icv/fmask, the +128 partition correction
+    at select_bin_by_feature) that otherwise only runs on real TPU."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.learner.rounds import build_tree_rounds
+    from lightgbm_tpu.learner.common import make_split_kw, padded_bin_count
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.binning import find_bin_mappers
+
+    rng = np.random.RandomState(7)
+    X = rng.randn(3000, 37)                   # 37 features: pads to 64
+    y = (X[:, 0] + 0.4 * X[:, 1] * X[:, 2] > 0).astype(np.float32)
+    cfg = Config(objective="binary", num_leaves=15, min_data_in_leaf=5)
+    mappers = find_bin_mappers(X, cfg.max_bin, cfg.min_data_in_bin,
+                               cfg.min_data_in_leaf, categorical=(),
+                               sample_cnt=len(X), seed=1)
+    bins = np.stack([m.values_to_bins(X[:, j]) if hasattr(m, "values_to_bins")
+                     else m.value_to_bin(X[:, j]) for j, m in
+                     enumerate(mappers)]).astype(np.int32)
+    F = bins.shape[0]
+    grad = (1.0 / (1.0 + np.exp(-0.0)) - y).astype(np.float32)
+    hess = np.full_like(grad, 0.25)
+    nb = np.asarray([m.num_bin for m in mappers], np.int32)
+    B = padded_bin_count(int(nb.max()))
+    kw = dict(num_leaves=15, num_bins_padded=B,
+              split_kw=make_split_kw(cfg), max_depth=0,
+              min_data_in_leaf=5, min_sum_hessian_in_leaf=1e-3,
+              backend="xla", max_num_bin=int(nb.max()))
+    common = (jnp.asarray(grad), jnp.asarray(hess),
+              jnp.ones(len(y), jnp.float32))
+
+    arrs32, lid32 = build_tree_rounds(
+        jnp.asarray(bins), *common, jnp.asarray(nb),
+        jnp.zeros(F, bool), jnp.ones(F, bool), **kw)
+
+    # int8 storage exactly as the TPU learner builds it: value-128,
+    # features padded to 32-multiple with trivial masked features
+    Fpad = 32 * ((F + 31) // 32)
+    bins8 = np.pad((bins.astype(np.int16) - 128).astype(np.int8),
+                   ((0, Fpad - F), (0, 0)), constant_values=-128)
+    nb8 = np.pad(nb, (0, Fpad - F), constant_values=1)
+    fmask8 = np.pad(np.ones(F, bool), (0, Fpad - F))
+    arrs8, lid8 = build_tree_rounds(
+        jnp.asarray(bins8), *common, jnp.asarray(nb8),
+        jnp.zeros(Fpad, bool), jnp.asarray(fmask8), **kw)
+
+    assert int(arrs32.num_leaves) == int(arrs8.num_leaves) > 1
+    np.testing.assert_array_equal(np.asarray(lid32), np.asarray(lid8))
+    np.testing.assert_array_equal(np.asarray(arrs32.split_feature),
+                                  np.asarray(arrs8.split_feature))
+    np.testing.assert_array_equal(np.asarray(arrs32.threshold_bin),
+                                  np.asarray(arrs8.threshold_bin))
+    np.testing.assert_allclose(np.asarray(arrs32.leaf_value),
+                               np.asarray(arrs8.leaf_value), rtol=1e-6)
