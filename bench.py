@@ -50,9 +50,13 @@ ROWS = int(os.environ.get("BENCH_ROWS", 10_500_000))
 ITERS = int(os.environ.get("BENCH_ITERS", 60))
 WARMUP = int(os.environ.get("BENCH_WARMUP", 3))
 LEAVES = int(os.environ.get("BENCH_LEAVES", 255))
-# histogram MXU precision; bfloat16 is the validated default
-# (tests/test_bf16.py), int8 is the experimental quantized kernel
-HIST_DTYPE = os.environ.get("BENCH_HIST_DTYPE", "bfloat16")
+# histogram MXU precision.  int8 (per-pass symmetric gradient
+# quantization, exact int32 accumulation) is the validated default:
+# 500-iteration full-shape AUC 0.889807 vs the reference binary's
+# 0.889423 on identical data (northstar_int8_accuracy.json), ~20%
+# faster than bfloat16 (k_sweep_measured.json).  bfloat16 remains the
+# validated fallback (tests/test_bf16.py).
+HIST_DTYPE = os.environ.get("BENCH_HIST_DTYPE", "int8")
 
 
 def synth_higgs(n, f=28, seed=42):

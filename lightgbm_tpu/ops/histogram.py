@@ -516,6 +516,12 @@ def hist_multileaf_masked(gb_t: jax.Array, lid: jax.Array, gh8: jax.Array,
     # tile is (32, 128), so the feature-group sublane dim grows to 32
     G = 32 if bin_offset else FEATURE_GROUP
     Ck = min(C, HIST_CHUNK)
+    if bin_offset and B > 128 and not quant:
+        # G=32 quadruples the per-cell output block (G·Mp·B·4 = 8 MB at
+        # B=256); the f32/bf16 kernel's wide-vals transients on top of
+        # that overflow the 16 MB VMEM scope at the default row chunk —
+        # shorter chunks shrink every transient except the output
+        Ck = min(Ck, 1024)
     if C % Ck:
         pad = Ck - C % Ck
         gb_t = jnp.pad(gb_t, ((0, 0), (0, pad)))

@@ -27,6 +27,7 @@ sys.path.insert(0, ROOT)
 
 SCALE = float(os.environ.get("SWEEP_SCALE", 1.0))
 ITERS = int(os.environ.get("SWEEP_ITERS", 15))
+HIST_DTYPE = os.environ.get("SWEEP_HIST_DTYPE", "bfloat16")
 WARMUP = 2
 
 
@@ -54,7 +55,7 @@ def run_case(name, X, y, max_bin):
     params = {"objective": "binary", "verbose": -1, "num_leaves": 255,
               "learning_rate": 0.1, "max_bin": max_bin,
               "min_data_in_leaf": 1, "min_sum_hessian_in_leaf": 100.0,
-              "histogram_dtype": "bfloat16"}
+              "histogram_dtype": HIST_DTYPE}
     t0 = time.perf_counter()
     train = lgb.Dataset(X, y).construct(params)
     t_bin = time.perf_counter() - t0
