@@ -375,19 +375,24 @@ class LambdarankNDCG(Objective):
             lq = np.sort(lab[qb[q]: qb[q + 1]])[::-1][:k]
             md = float((label_gain[lq] * discount[: len(lq)]).sum())
             inv_max_dcg[q] = 1.0 / md if md > 0 else 0.0
+        # chunk queries so the [q, D, D] pairwise block stays ~64MB.
+        # Q is padded UP to a chunk multiple with all-sentinel queries
+        # (empty mask -> zero lambdas) — requiring qc | Q would
+        # degenerate to qc=1 (fully serial scan) whenever Q is prime
+        sigmoid = self.config.sigmoid
+        N = num_data
+        qc = max(1, min(Q, (1 << 24) // max(D * D, 1)))
+        Qp = qc * ((Q + qc - 1) // qc)
+        if Qp > Q:
+            doc_idx = np.pad(doc_idx, ((0, Qp - Q), (0, 0)),
+                             constant_values=num_data)
+            inv_max_dcg = np.pad(inv_max_dcg, (0, Qp - Q))
         self._doc_idx = jnp.asarray(doc_idx)
         self._mask = jnp.asarray(doc_idx < num_data)
         self._inv_max_dcg = jnp.asarray(inv_max_dcg, jnp.float32)
         self._label_gain = jnp.asarray(label_gain, jnp.float32)
         self._discount = jnp.asarray(discount, jnp.float32)
         self._lab_pad = jnp.asarray(np.concatenate([lab, [0]]).astype(jnp.int32))
-        sigmoid = self.config.sigmoid
-        N = num_data
-
-        # chunk queries so the [q, D, D] pairwise block stays ~64MB
-        qc = max(1, min(Q, (1 << 24) // max(D * D, 1)))
-        while Q % qc:
-            qc -= 1
         self._q_chunk = qc
 
         @jax.jit

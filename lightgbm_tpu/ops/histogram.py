@@ -308,7 +308,7 @@ def hist_multileaf(gb_t: jax.Array, vals: jax.Array, *, num_bins_padded: int,
 
 
 def _packed_onehot(gb_ref, g_, B, pack, bins_sub, out_dtype,
-                   bin_offset=0):
+                   bin_offset=0, bwin=0):
     """One-hot block for `pack` features sharing the 128 lanes: feature
     s of the pack occupies lanes [s·bins_sub, (s+1)·bins_sub), so ONE
     [M, Ck] @ [Ck, B] matmul histograms all `pack` features — the fix
@@ -318,8 +318,15 @@ def _packed_onehot(gb_ref, g_, B, pack, bins_sub, out_dtype,
 
     bin_offset: bins may arrive stored as int8 `bin - 128` (the HBM
     layout that fits Expo-scale 11M x 700 on one chip); the widen +
-    un-offset runs here in VMEM, never materializing wide bins."""
-    iota = jax.lax.broadcasted_iota(jnp.int32, (1, B), 1)
+    un-offset runs here in VMEM, never materializing wide bins.
+
+    bwin: first bin of this grid cell's output window (the bin axis may
+    be split across a grid dimension so the per-cell output block stays
+    one 128-lane tile — the full [G, Mp, 256] block double-buffers to
+    16 MB and overflows VMEM on multi-feature-block grids).  B here is
+    the WINDOW width (the out block's lane count), not the full bin
+    count."""
+    iota = jax.lax.broadcasted_iota(jnp.int32, (1, B), 1) + bwin
     acc = None
     for s in range(pack):
         gb = gb_ref[0, g_ * pack + s, :].astype(jnp.int32) + bin_offset
@@ -348,10 +355,15 @@ def _hist_kernel_masked(sl_ref, gb_ref, lid_ref, gh_ref, out_ref, *,
     Fusing the mask construction here avoids materializing the [3K, N]
     values matrix in HBM per chunk (the XLA-level formulation round-trips
     ~0.5 GB per histogram pass at N=1M).
+
+    Grid is (feature-blocks, bin-windows, row-chunks); the out block
+    covers one 128-lane bin window.
     """
     from jax.experimental import pallas as pl
 
-    k = pl.program_id(1)
+    k = pl.program_id(2)
+    Bs = out_ref.shape[3]
+    bwin = pl.program_id(1) * Bs
 
     @pl.when(k == 0)
     def _init():
@@ -373,8 +385,8 @@ def _hist_kernel_masked(sl_ref, gb_ref, lid_ref, gh_ref, out_ref, *,
             else jax.lax.Precision.DEFAULT)
     G = gb_ref.shape[1]
     for g_ in range(G // pack):
-        oh = _packed_onehot(gb_ref, g_, B, pack, bins_sub, input_dtype,
-                            bin_offset)
+        oh = _packed_onehot(gb_ref, g_, Bs, pack, bins_sub, input_dtype,
+                            bin_offset, bwin)
         out_ref[0, g_, :, :] += jnp.dot(
             vals, oh, preferred_element_type=jnp.float32, precision=prec)
 
@@ -389,10 +401,13 @@ def _hist_kernel_masked_q(sl_ref, gb_ref, lid_ref, ghq_ref, out_ref, *,
     as int32; dequantization happens in the caller.  Every product is
     exact: masks are 0/1 and |q| <= 127.  Accumulation is exact while
     127 * rows_per_device < 2^31 — the caller enforces a 16M-row bound
-    and falls back to bfloat16 beyond it."""
+    and falls back to bfloat16 beyond it.  Grid is (feature-blocks,
+    bin-windows, row-chunks) like _hist_kernel_masked."""
     from jax.experimental import pallas as pl
 
-    k = pl.program_id(1)
+    k = pl.program_id(2)
+    Bs = out_ref.shape[3]
+    bwin = pl.program_id(1) * Bs
 
     @pl.when(k == 0)
     def _init():
@@ -415,8 +430,8 @@ def _hist_kernel_masked_q(sl_ref, gb_ref, lid_ref, ghq_ref, out_ref, *,
     vals = vals32.astype(jnp.int8)
     G = gb_ref.shape[1]
     for g_ in range(G // pack):
-        oh = _packed_onehot(gb_ref, g_, B, pack, bins_sub, jnp.int8,
-                            bin_offset)
+        oh = _packed_onehot(gb_ref, g_, Bs, pack, bins_sub, jnp.int8,
+                            bin_offset, bwin)
         out_ref[0, g_, :, :] += jnp.dot(
             vals, oh, preferred_element_type=jnp.int32)
 
@@ -465,7 +480,7 @@ def hist_multileaf_masked(gb_t: jax.Array, lid: jax.Array, gh8: jax.Array,
     max_num_bin (static; 0 = unknown) enables feature packing on the
     pallas path when all bins fit a 16/32/64-lane sub-block.
 
-    input_dtype "int8" (EXPERIMENTAL, opt-in) selects per-pass symmetric
+    input_dtype "int8" (the validated bench default) selects per-pass symmetric
     gradient quantization with exact int32 accumulation: counts are
     exact, grad/hess entries carry <= |max|/254 absolute rounding error
     each — far finer than LightGBM-4-style 2-5 bit quantized training.
@@ -516,12 +531,6 @@ def hist_multileaf_masked(gb_t: jax.Array, lid: jax.Array, gh8: jax.Array,
     # tile is (32, 128), so the feature-group sublane dim grows to 32
     G = 32 if bin_offset else FEATURE_GROUP
     Ck = min(C, HIST_CHUNK)
-    if bin_offset and B > 128 and not quant:
-        # G=32 quadruples the per-cell output block (G·Mp·B·4 = 8 MB at
-        # B=256); the f32/bf16 kernel's wide-vals transients on top of
-        # that overflow the 16 MB VMEM scope at the default row chunk —
-        # shorter chunks shrink every transient except the output
-        Ck = min(Ck, 1024)
     if C % Ck:
         pad = Ck - C % Ck
         gb_t = jnp.pad(gb_t, ((0, 0), (0, pad)))
@@ -538,14 +547,21 @@ def hist_multileaf_masked(gb_t: jax.Array, lid: jax.Array, gh8: jax.Array,
     Kp = 8 * ((K + 7) // 8)
     sl2 = jnp.broadcast_to(jnp.pad(sl, (0, Kp - K),
                                    constant_values=-1)[:, None], (Kp, 128))
-    grid = (Fg // G, C // Ck)
     bins_sub, pack = packed_bins_layout(max_num_bin, B)
     Gp = G // pack
+    # bin windows: one 128-lane output block per grid cell.  The full
+    # [1, Gp, Mp, 256] block is 8 MB at G=32 and double-buffers to 16 MB
+    # across feature blocks — over the VMEM scope.  Splitting the bin
+    # axis over the grid keeps the block one lane-tile wide; the one-hot
+    # compare is redone per window (cheap), the matmul work is unchanged.
+    nB = B // 128 if (bin_offset and B > 128 and Fg > G) else 1
+    Bs = B // nB
+    grid = (Fg // G, nB, C // Ck)
     in_specs = [
-        pl.BlockSpec((Kp, 128), lambda f, k: (0, 0)),
-        pl.BlockSpec((1, G, Ck), lambda f, k: (f, 0, k)),
-        pl.BlockSpec((1, Ck), lambda f, k: (0, k)),
-        pl.BlockSpec((8, Ck), lambda f, k: (0, k)),
+        pl.BlockSpec((Kp, 128), lambda f, b, k: (0, 0)),
+        pl.BlockSpec((1, G, Ck), lambda f, b, k: (f, 0, k)),
+        pl.BlockSpec((1, Ck), lambda f, b, k: (0, k)),
+        pl.BlockSpec((8, Ck), lambda f, b, k: (0, k)),
     ]
 
     def unpack(out):
@@ -567,8 +583,8 @@ def hist_multileaf_masked(gb_t: jax.Array, lid: jax.Array, gh8: jax.Array,
             out_shape=jax.ShapeDtypeStruct((Fg // G, Gp, Mp, B), jnp.int32),
             grid=grid,
             in_specs=in_specs,
-            out_specs=pl.BlockSpec((1, Gp, Mp, B),
-                                   lambda f, k: (f, 0, 0, 0)),
+            out_specs=pl.BlockSpec((1, Gp, Mp, Bs),
+                                   lambda f, b, k: (f, 0, 0, b)),
             interpret=interpret,
         )(sl2, gb_g, lid[None, :], ghq)
         h = unpack(out).astype(jnp.float32)
@@ -584,7 +600,7 @@ def hist_multileaf_masked(gb_t: jax.Array, lid: jax.Array, gh8: jax.Array,
         out_shape=jax.ShapeDtypeStruct((Fg // G, Gp, Mp, B), jnp.float32),
         grid=grid,
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, Gp, Mp, B), lambda f, k: (f, 0, 0, 0)),
+        out_specs=pl.BlockSpec((1, Gp, Mp, Bs), lambda f, b, k: (f, 0, 0, b)),
         interpret=interpret,
     )(sl2, gb_g, lid[None, :], gh8)
     h = unpack(out)                                      # [F, Mp, B]
