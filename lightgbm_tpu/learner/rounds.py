@@ -403,10 +403,15 @@ class RoundsTreeLearner:
         backend = ("pallas" if jax.default_backend() == "tpu" else "xla")
         nbv = dataset.num_bins.astype(np.int32)
         icv = np.asarray(dataset.is_categorical)
-        if backend == "pallas" and dataset.max_num_bin <= 256:
+        if backend == "pallas" and dataset.max_num_bin <= 256 \
+                and self._want_int8_bins():
             # int8 HBM layout (value - 128): 4x less device memory and
             # bandwidth than int32 — what fits Expo's 11M x 700 store
-            # (7.7 GB vs 30.8 GB) on one v5e chip
+            # (7.7 GB vs 30.8 GB) on one v5e chip.  Memory-gated: the
+            # G=32 block layout it forces measured ~60% slower than the
+            # int32 G=8 layout on wide 255-bin data (Epsilon shape), so
+            # narrow storage is chosen only when int32 bins would crowd
+            # the device (see _want_int8_bins).
             bins_np = (dataset.bins.astype(np.int16) - 128).astype(np.int8)
             # pad features to the int8 kernel's 32-sublane group on the
             # HOST: a device-side pad would briefly hold a second full
@@ -473,6 +478,26 @@ class RoundsTreeLearner:
         # (nbv/icv already carry the int8 feature padding)
         self.num_bins_dev = nbv if self.mh is not None else jnp.asarray(nbv)
         self.is_cat_dev = icv if self.mh is not None else jnp.asarray(icv)
+
+    def _want_int8_bins(self) -> bool:
+        """Narrow bin storage only under memory pressure: int32 bins
+        beyond ~25% of device HBM (Expo-scale) switch to the int8
+        value-128 layout; narrow/regular data keeps the faster int32
+        G=8 kernel layout.  LGBT_BINS_INT8=0/1 overrides for on-chip
+        experiments."""
+        import os
+        ov = os.environ.get("LGBT_BINS_INT8", "")
+        if ov in ("0", "1"):
+            return ov == "1"
+        # bins shard along the data axis: the pressure that matters is
+        # the PER-DEVICE share of the int32 layout
+        int32_bytes = 4.0 * self.F * self.Np / max(self.dd, 1)
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+            limit = float(stats.get("bytes_limit", 0)) or 16e9
+        except Exception:
+            limit = 16e9
+        return int32_bytes > 0.25 * limit
 
     @property
     def bins_t(self) -> jax.Array:

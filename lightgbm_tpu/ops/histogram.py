@@ -339,7 +339,8 @@ def _packed_onehot(gb_ref, g_, B, pack, bins_sub, out_dtype,
 
 def _hist_kernel_masked(sl_ref, gb_ref, lid_ref, gh_ref, out_ref, *,
                         B: int, K: int, input_dtype, pack: int = 1,
-                        bins_sub: int = 0, bin_offset: int = 0):
+                        bins_sub: int = 0, bin_offset: int = 0,
+                        windowed: bool = False):
     """Multi-leaf histogram with the leaf masks built in VMEM.
 
     sl_ref : [Kp, 128] int32 — small-leaf id per slot, replicated across
@@ -356,14 +357,19 @@ def _hist_kernel_masked(sl_ref, gb_ref, lid_ref, gh_ref, out_ref, *,
     values matrix in HBM per chunk (the XLA-level formulation round-trips
     ~0.5 GB per histogram pass at N=1M).
 
-    Grid is (feature-blocks, bin-windows, row-chunks); the out block
+    Grid is (feature-blocks, row-chunks), or (feature-blocks,
+    bin-windows, row-chunks) when `windowed` — the out block then
     covers one 128-lane bin window.
     """
     from jax.experimental import pallas as pl
 
-    k = pl.program_id(2)
+    if windowed:
+        k = pl.program_id(2)
+        bwin = pl.program_id(1) * out_ref.shape[3]
+    else:
+        k = pl.program_id(1)
+        bwin = 0
     Bs = out_ref.shape[3]
-    bwin = pl.program_id(1) * Bs
 
     @pl.when(k == 0)
     def _init():
@@ -393,7 +399,8 @@ def _hist_kernel_masked(sl_ref, gb_ref, lid_ref, gh_ref, out_ref, *,
 
 def _hist_kernel_masked_q(sl_ref, gb_ref, lid_ref, ghq_ref, out_ref, *,
                           B: int, K: int, pack: int = 1,
-                          bins_sub: int = 0, bin_offset: int = 0):
+                          bins_sub: int = 0, bin_offset: int = 0,
+                          windowed: bool = False):
     """int8-quantized variant of _hist_kernel_masked: vals and one-hot
     are int8 and the contraction accumulates exactly in int32 (v5e runs
     int8 MXU matmuls at 2x bf16 throughput).  ghq rows are pre-quantized
@@ -401,13 +408,17 @@ def _hist_kernel_masked_q(sl_ref, gb_ref, lid_ref, ghq_ref, out_ref, *,
     as int32; dequantization happens in the caller.  Every product is
     exact: masks are 0/1 and |q| <= 127.  Accumulation is exact while
     127 * rows_per_device < 2^31 — the caller enforces a 16M-row bound
-    and falls back to bfloat16 beyond it.  Grid is (feature-blocks,
-    bin-windows, row-chunks) like _hist_kernel_masked."""
+    and falls back to bfloat16 beyond it.  Grid as in
+    _hist_kernel_masked (bin-window axis only when `windowed`)."""
     from jax.experimental import pallas as pl
 
-    k = pl.program_id(2)
+    if windowed:
+        k = pl.program_id(2)
+        bwin = pl.program_id(1) * out_ref.shape[3]
+    else:
+        k = pl.program_id(1)
+        bwin = 0
     Bs = out_ref.shape[3]
-    bwin = pl.program_id(1) * Bs
 
     @pl.when(k == 0)
     def _init():
@@ -554,15 +565,30 @@ def hist_multileaf_masked(gb_t: jax.Array, lid: jax.Array, gh8: jax.Array,
     # across feature blocks — over the VMEM scope.  Splitting the bin
     # axis over the grid keeps the block one lane-tile wide; the one-hot
     # compare is redone per window (cheap), the matmul work is unchanged.
-    nB = B // 128 if (bin_offset and B > 128 and Fg > G) else 1
+    nB = B // 128 if (bin_offset and B > 128) else 1
     Bs = B // nB
-    grid = (Fg // G, nB, C // Ck)
-    in_specs = [
-        pl.BlockSpec((Kp, 128), lambda f, b, k: (0, 0)),
-        pl.BlockSpec((1, G, Ck), lambda f, b, k: (f, 0, k)),
-        pl.BlockSpec((1, Ck), lambda f, b, k: (0, k)),
-        pl.BlockSpec((8, Ck), lambda f, b, k: (0, k)),
-    ]
+    if nB > 1:
+        grid = (Fg // G, nB, C // Ck)
+        in_specs = [
+            pl.BlockSpec((Kp, 128), lambda f, b, k: (0, 0)),
+            pl.BlockSpec((1, G, Ck), lambda f, b, k: (f, 0, k)),
+            pl.BlockSpec((1, Ck), lambda f, b, k: (0, k)),
+            pl.BlockSpec((8, Ck), lambda f, b, k: (0, k)),
+        ]
+        out_spec = pl.BlockSpec((1, Gp, Mp, Bs),
+                                lambda f, b, k: (f, 0, 0, b))
+    else:
+        # keep the plain 2-axis grid when no windowing is needed: the
+        # singleton middle axis measurably deoptimized Mosaic's
+        # pipelining (learner-level 2.5x at Epsilon 63-bin)
+        grid = (Fg // G, C // Ck)
+        in_specs = [
+            pl.BlockSpec((Kp, 128), lambda f, k: (0, 0)),
+            pl.BlockSpec((1, G, Ck), lambda f, k: (f, 0, k)),
+            pl.BlockSpec((1, Ck), lambda f, k: (0, k)),
+            pl.BlockSpec((8, Ck), lambda f, k: (0, k)),
+        ]
+        out_spec = pl.BlockSpec((1, Gp, Mp, Bs), lambda f, k: (f, 0, 0, 0))
 
     def unpack(out):
         """[Fg/G, G/pack, Mp, B] kernel output -> [F, Mp, B] with each
@@ -579,12 +605,12 @@ def hist_multileaf_masked(gb_t: jax.Array, lid: jax.Array, gh8: jax.Array,
         ghq, sg, sh = _quantize_gh(gh8)
         out = pl.pallas_call(
             functools.partial(_hist_kernel_masked_q, B=B, K=K, pack=pack,
-                              bins_sub=bins_sub, bin_offset=bin_offset),
+                              bins_sub=bins_sub, bin_offset=bin_offset,
+                              windowed=nB > 1),
             out_shape=jax.ShapeDtypeStruct((Fg // G, Gp, Mp, B), jnp.int32),
             grid=grid,
             in_specs=in_specs,
-            out_specs=pl.BlockSpec((1, Gp, Mp, Bs),
-                                   lambda f, b, k: (f, 0, 0, b)),
+            out_specs=out_spec,
             interpret=interpret,
         )(sl2, gb_g, lid[None, :], ghq)
         h = unpack(out).astype(jnp.float32)
@@ -596,11 +622,11 @@ def hist_multileaf_masked(gb_t: jax.Array, lid: jax.Array, gh8: jax.Array,
     out = pl.pallas_call(
         functools.partial(_hist_kernel_masked, B=B, K=K, input_dtype=dt,
                           pack=pack, bins_sub=bins_sub,
-                          bin_offset=bin_offset),
+                          bin_offset=bin_offset, windowed=nB > 1),
         out_shape=jax.ShapeDtypeStruct((Fg // G, Gp, Mp, B), jnp.float32),
         grid=grid,
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, Gp, Mp, Bs), lambda f, b, k: (f, 0, 0, b)),
+        out_specs=out_spec,
         interpret=interpret,
     )(sl2, gb_g, lid[None, :], gh8)
     h = unpack(out)                                      # [F, Mp, B]
