@@ -47,6 +47,12 @@ class Metric:
         """score: [N] or [K, N] raw scores.  Returns [(name, value)]."""
         raise NotImplementedError
 
+    def result_names(self) -> List[str]:
+        """Names eval() will emit, WITHOUT evaluating (one metric can
+        yield several results, e.g. ndcg@1,3,5) — the C ABI's
+        GetEvalCounts/GetEvalNames read these (c_api.h:438-446)."""
+        return [self.name]
+
     # -- device path --------------------------------------------------------
     def _dev(self):
         """Lazy device copies of label/weights (shared per metric; built
@@ -287,6 +293,10 @@ def _dcg_tables(config: Config, max_len: int):
 class NDCGMetric(Metric):
     name = "ndcg"
     factor_to_bigger_better = 1.0
+
+    def result_names(self) -> List[str]:
+        return [f"{self.name}@{int(k)}"
+                for k in self.config.ndcg_eval_at]
 
     def _host_qw(self):
         """query_weights derivation is O(N); cache it — weights are
