@@ -49,30 +49,28 @@ from ..tree import Tree
 
 NEG_INF = -jnp.inf
 # Leaves histogrammed per multi-leaf pass.  3·K is the M dimension of the
-# hist matmul; M > 128 tiles onto the MXU, and a LARGER K means FEWER
-# full-row passes per round — the per-pass costs (one-hot construction on
-# the VPU, bin reads from HBM) amortize over more leaves.  84 (M=256)
-# halves the pass count of the old 42 at constant MXU work, so the
-# pass-count model predicts it faster; grown trees agree across K up to
-# f32 summation-order ulps (tests/test_rounds.py::
+# hist matmul, and a LARGER K means FEWER full-row passes per round.  The
+# ISOLATED kernel's per-pass cost is nearly flat in K on the int8 path
+# (207 ms at K=1 vs 214 ms at K=128 on the north-star shape,
+# profile_hotpath_measured.json), which predicts K=128 — one chunk per
+# round — should win.  The in-learner A/B on chip says otherwise: at the
+# north-star shape, end-to-end s/iter with K=128 was NOT faster than
+# K=84 (rounds rarely split a full 128 leaves, and the masked kernel's
+# work scales with the padded M, so late narrow rounds pay for leaves
+# that aren't there).  84 (M=256) stays the measured default for every
+# precision; bf16/f32 additionally slow down outright at M=384 (258 ms
+# → 404 ms per pass).  Grown trees agree across K up to f32
+# summation-order ulps (tests/test_rounds.py::
 # test_leaves_per_batch_k_independent) and LGBT_LEAVES_PER_BATCH
-# overrides for on-chip tuning (scripts/profile_hotpath.py).
+# overrides the default for on-chip tuning.
 import os as _os
 
 
-def _leaves_per_batch_from_env() -> int:
-    """Defensive parse (a malformed value must not break every import)
-    clamped to [1, 336]: 3K is the matmul M dim and the masked kernel's
-    VMEM vals block is [3K, chunk] — 336 (M=1024) is ~8 MB at the
-    default chunk, a safe ceiling well past any profitable K."""
-    raw = _os.environ.get("LGBT_LEAVES_PER_BATCH", "") or "84"
-    try:
-        v = int(raw)
-    except ValueError:
-        from .. import log
-        log.warning(f"ignoring malformed LGBT_LEAVES_PER_BATCH={raw!r}; "
-                    "using 84")
-        v = 84
+def _clamp_k(v: int) -> int:
+    """Clamp to [1, 336]: 3K is the matmul M dim and the masked kernel's
+    VMEM vals block is [3K, chunk] — 336 (M=1024) is a safe ceiling well
+    past any profitable K (the chunk cap in ops/histogram.py shrinks the
+    row chunk to keep the block inside VMEM)."""
     c = max(1, min(v, 336))
     if c != v:
         from .. import log
@@ -80,7 +78,27 @@ def _leaves_per_batch_from_env() -> int:
     return c
 
 
-LEAVES_PER_BATCH = _leaves_per_batch_from_env()
+def _leaves_per_batch_from_env() -> Optional[int]:
+    """Defensive parse (a malformed value must not break every import);
+    None when unset — the module default (84) then applies."""
+    raw = _os.environ.get("LGBT_LEAVES_PER_BATCH", "")
+    if not raw:
+        return None
+    try:
+        v = int(raw)
+    except ValueError:
+        from .. import log
+        log.warning(f"ignoring malformed LGBT_LEAVES_PER_BATCH={raw!r}; "
+                    "using the default (84)")
+        return None
+    return _clamp_k(v)
+
+
+# K for one masked histogram pass: env override, else the chip-measured
+# 84 (see the block comment above — the kernel-level case for K=128 on
+# int8 did not survive the end-to-end A/B).  Read at call time by
+# build_tree_rounds so tests can monkeypatch it.
+LEAVES_PER_BATCH = _leaves_per_batch_from_env() or 84
 
 
 def _psum(x, axis):
@@ -96,7 +114,8 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
                       backend: str = "xla",
                       input_dtype: str = "float32",
                       max_rounds: int = 0,
-                      cache_parent_hist: bool = True):
+                      cache_parent_hist: bool = True,
+                      leaves_per_batch: int = 0):
     """Grow one tree in batched rounds.  Shapes as learner/fused.build_tree.
     Returns (TreeArrays, leaf_id).
 
@@ -109,7 +128,7 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
     F, Nloc = bins.shape
     L = num_leaves
     B = num_bins_padded
-    K = LEAVES_PER_BATCH
+    K = leaves_per_batch or LEAVES_PER_BATCH
     n_chunks = (L + K - 1) // K
     # Termination is governed by the while_loop predicate (no positive gain
     # or num_leaves reached); R is only a provably non-binding safety bound:

@@ -90,15 +90,20 @@ FEATURE_GROUP = 8  # features per kernel block (TPU second-minor tiling)
 import os as _os
 
 
-def _hist_chunk_from_env() -> int:
+def _hist_chunk_from_env(default: int) -> int:
     try:
-        v = int(_os.environ.get("LGBT_HIST_CHUNK", "") or 2048)
+        v = int(_os.environ.get("LGBT_HIST_CHUNK", "") or default)
     except ValueError:
-        v = 2048
+        v = default
     return max(512, (v // 128) * 128)
 
 
-HIST_CHUNK = _hist_chunk_from_env()
+# The gather-fed kernels keep the conservative chunk (their f32 one-hot
+# transient is 4x the masked kernel's int8 ones); the masked hot-path
+# kernel defaults larger — chip-measured ~6% faster per pass at 8192 —
+# and self-caps by a VMEM model (see hist_multileaf_masked).
+HIST_CHUNK = _hist_chunk_from_env(2048)
+MASKED_HIST_CHUNK = _hist_chunk_from_env(8192)
 
 
 def _coerce_dtype(input_dtype: str) -> str:
@@ -541,7 +546,22 @@ def hist_multileaf_masked(gb_t: jax.Array, lid: jax.Array, gh8: jax.Array,
     # int8 bins keep their narrow dtype into the kernel; the int8 VMEM
     # tile is (32, 128), so the feature-group sublane dim grows to 32
     G = 32 if bin_offset else FEATURE_GROUP
-    Ck = min(C, HIST_CHUNK)
+    Ck = min(C, MASKED_HIST_CHUNK)
+    if bin_offset:
+        # the G=32 layout quadruples the per-cell output block
+        # (G·Mp·B·4 at B=256 double-buffers past the 16 MB VMEM scope
+        # with long row chunks); keep the chip-validated chunk
+        Ck = min(Ck, 2048)
+    else:
+        # cap the big per-chunk transients — the [Mp, Ck] vals
+        # intermediate (int32 when quantizing, else the operand dtype)
+        # plus the [Ck, B] one-hot — at ~15 MB, the measured VMEM
+        # ceiling: Mp=256/Ck=16384 int32 vals (16.8 MB alone) OOMs on
+        # chip, Mp=384/Ck=8192 (12.6 + 2 MB) fits
+        Mp_ = 8 * ((3 * K + 7) // 8)
+        isz = jnp.dtype(input_dtype).itemsize
+        per_row = Mp_ * (4 if quant else isz) + B * (1 if quant else isz)
+        Ck = min(Ck, max(512, (int(15e6) // per_row) // 128 * 128))
     if C % Ck:
         pad = Ck - C % Ck
         gb_t = jnp.pad(gb_t, ((0, 0), (0, pad)))

@@ -1,13 +1,18 @@
 """Microbenchmark the training hot path on the live chip.
 
 Times each device op of the rounds learner in isolation at the
-north-star shape, then one full Booster.update, so the gap between
-"sum of parts" and the whole iteration (host orchestration, fusion
-losses) is visible.  Usage:
+north-star shape — the masked multi-leaf histogram kernel in every
+supported precision, the partition ops — then one full Booster.update,
+so the gap between "sum of parts" and the whole iteration (host
+orchestration, dispatch latency, fusion losses) is visible.  Writes
+profile_hotpath_measured.json at the repo root (the committed MFU
+evidence behind BASELINE.md's "honest bar" analysis).  Usage:
 
     python scripts/profile_hotpath.py [N] [F] [max_bin]
 """
+import json
 import os
+import subprocess
 import sys
 import time
 
@@ -20,18 +25,30 @@ N = int(sys.argv[1]) if len(sys.argv) > 1 else 10_500_000
 F = int(sys.argv[2]) if len(sys.argv) > 2 else 28
 MB = int(sys.argv[3]) if len(sys.argv) > 3 else 255
 from lightgbm_tpu.learner.rounds import LEAVES_PER_BATCH as K  # noqa: E402
-DT = "bfloat16"
+
+# v5e peak matmul throughput per chip (public spec: 394 TOPS int8,
+# 197 TFLOP/s bf16) — the denominators for MXU utilization
+PEAK = {"int8": 394e12, "bfloat16": 197e12, "float32": 49e12}
+
+
+def _force(r):
+    """Wait for r by FETCHING a scalar reduction of it.  On the tunneled
+    remote-TPU platform block_until_ready can return before the remote
+    execution finishes; a value fetch cannot."""
+    import jax.numpy as jnp
+    return float(jnp.sum(jnp.asarray(r).astype(jnp.float32)))
 
 
 def timeit(fn, *args, n=5, warmup=2):
-    import jax
     for _ in range(warmup):
         r = fn(*args)
-    jax.block_until_ready(r)
+    _force(r)
     t0 = time.perf_counter()
     for _ in range(n):
         r = fn(*args)
-    jax.block_until_ready(r)
+    # the device stream is serial: fetching the LAST result bounds all n
+    # executions; one fetch RTT is amortized over n
+    _force(r)
     return (time.perf_counter() - t0) / n
 
 
@@ -51,41 +68,69 @@ def main():
     gh8 = jnp.asarray(rng.randn(8, N).astype(np.float32))
     sl = jnp.asarray(np.arange(K, dtype=np.int32))
 
-    t = timeit(lambda: hist_multileaf_masked(
-        bins, lid, gh8, sl, num_bins_padded=B, backend=backend,
-        input_dtype=DT))
-    mxu = N * F * (8 * ((3 * K + 7) // 8)) * B * 2 / 1e12
-    print(f"hist_multileaf_masked K={K}: {t*1e3:.1f} ms  "
-          f"({mxu / t:.0f} TFLOP/s effective)")
+    rec = {"backend": jax.default_backend(), "N": N, "F": F, "B": B, "K": K,
+           "kernels": {}}
+    try:
+        rec["measured_at_commit"] = subprocess.run(
+            ["git", "describe", "--always", "--dirty"], cwd=ROOT,
+            capture_output=True, text=True).stdout.strip() or "unknown"
+    except OSError:
+        rec["measured_at_commit"] = "unknown"
+
+    Mp = 8 * ((3 * K + 7) // 8)
+    macs = float(N) * F * Mp * B  # one-hot contraction MACs per pass
+    for dt in ("int8", "bfloat16", "float32"):
+        t = timeit(lambda dt=dt: hist_multileaf_masked(
+            bins, lid, gh8, sl, num_bins_padded=B, backend=backend,
+            input_dtype=dt))
+        util = 2 * macs / t / PEAK[dt]
+        rec["kernels"][f"hist_multileaf_masked_K{K}_{dt}"] = {
+            "ms": round(t * 1e3, 2),
+            "effective_tops": round(2 * macs / t / 1e12, 1),
+            "mxu_utilization": round(util, 3)}
+        print(f"hist_multileaf_masked K={K} {dt}: {t*1e3:.1f} ms  "
+              f"({2 * macs / t / 1e12:.0f} TOPS = "
+              f"{util:.0%} of {dt} peak)")
 
     t1 = timeit(lambda: hist_multileaf_masked(
         bins, lid, gh8, jnp.asarray(np.arange(1, dtype=np.int32)),
-        num_bins_padded=B, backend=backend, input_dtype=DT))
+        num_bins_padded=B, backend=backend, input_dtype="int8"))
+    rec["kernels"]["hist_multileaf_masked_K1_root"] = {
+        "ms": round(t1 * 1e3, 2)}
     print(f"hist_multileaf_masked K=1 (root): {t1*1e3:.1f} ms")
 
     t2 = timeit(lambda: select_bin_by_feature(bins, lid % F))
+    rec["kernels"]["select_bin_by_feature"] = {"ms": round(t2 * 1e3, 2)}
     print(f"select_bin_by_feature: {t2*1e3:.1f} ms")
 
     tbl = jnp.asarray(rng.randn(4, 256).astype(np.float32))
     t3 = timeit(lambda: table_lookup(tbl, lid, num_slots=256))
+    rec["kernels"]["table_lookup_4x256"] = {"ms": round(t3 * 1e3, 2)}
     print(f"table_lookup [4,256]: {t3*1e3:.1f} ms")
 
-    # full iteration for the same shape
+    # full iteration at the same shape, bench-default precision
     import lightgbm_tpu as lgb
     import bench
     X, y = bench.synth_higgs(N, f=F)
     params = {"objective": "binary", "verbose": -1, "num_leaves": 255,
               "learning_rate": 0.1, "max_bin": MB, "min_data_in_leaf": 1,
-              "min_sum_hessian_in_leaf": 100.0, "histogram_dtype": DT}
+              "min_sum_hessian_in_leaf": 100.0, "histogram_dtype": "int8"}
     ds = lgb.Dataset(X, y)
     bst = lgb.Booster(params, ds)
     for _ in range(3):
         bst.update()
+    _force(bst._gbdt.train_score.score)
     t0 = time.perf_counter()
     for _ in range(10):
         bst.update()
-    jax.block_until_ready(bst._gbdt.train_score.score)
-    print(f"full update(): {(time.perf_counter()-t0)/10*1e3:.1f} ms/iter")
+    _force(bst._gbdt.train_score.score)
+    full = (time.perf_counter() - t0) / 10
+    rec["full_update_ms"] = round(full * 1e3, 1)
+    print(f"full update(): {full*1e3:.1f} ms/iter")
+
+    with open(os.path.join(ROOT, "profile_hotpath_measured.json"),
+              "w") as f:
+        json.dump(rec, f, indent=1)
 
 
 if __name__ == "__main__":
