@@ -99,9 +99,14 @@ def main():
     bst = lgb.Booster(params, train)
     for _ in range(WARMUP):          # compile + cache warm
         bst.update()
+    float(bst._gbdt.train_score.score.sum())   # drain warmup in-flight work
     t0 = time.perf_counter()
     for _ in range(ITERS):
         bst.update()
+    # value fetch: bounds the in-flight pipelined iteration (update()
+    # syncs only the PREVIOUS tree; block_until_ready can return early
+    # on the tunneled remote-TPU platform)
+    float(bst._gbdt.train_score.score.sum())
     dt = time.perf_counter() - t0
     s_per_iter = dt / ITERS
 

@@ -67,11 +67,11 @@ def main():
     bst = lgb.Booster(params, train)
     for _ in range(WARMUP):
         bst.update()
-    jax.block_until_ready(bst._gbdt.train_score.score)
+    float(bst._gbdt.train_score.score.sum())  # value fetch (tunnel-safe sync)
     t0 = time.perf_counter()
     for _ in range(ITERS):
         bst.update()
-    jax.block_until_ready(bst._gbdt.train_score.score)
+    float(bst._gbdt.train_score.score.sum())  # value fetch (tunnel-safe sync)
     s_iter = (time.perf_counter() - t0) / ITERS
 
     # categorical split sanity: the model uses equality decisions and

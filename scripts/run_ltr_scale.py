@@ -72,13 +72,13 @@ def main():
             bst.update()
             if with_eval:
                 ndcg = bst._gbdt.eval_valid()
-        jax.block_until_ready(bst._gbdt.train_score.score)
+        float(bst._gbdt.train_score.score.sum())  # value fetch (tunnel-safe sync)
         t0 = time.perf_counter()
         for _ in range(ITERS):
             bst.update()
             if with_eval:
                 ndcg = bst._gbdt.eval_valid()
-        jax.block_until_ready(bst._gbdt.train_score.score)
+        float(bst._gbdt.train_score.score.sum())  # value fetch (tunnel-safe sync)
         return (time.perf_counter() - t0) / ITERS, ndcg
 
     s_noeval, _ = run(False)

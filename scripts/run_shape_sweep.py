@@ -62,10 +62,11 @@ def run_case(name, X, y, max_bin):
     bst = lgb.Booster(params, train)
     for _ in range(WARMUP):
         bst.update()
+    float(bst._gbdt.train_score.score.sum())  # drain warmup in-flight work
     t0 = time.perf_counter()
     for _ in range(ITERS):
         bst.update()
-    jax.block_until_ready(bst._gbdt.train_score.score)
+    float(bst._gbdt.train_score.score.sum())  # value fetch (tunnel-safe sync)
     dt = (time.perf_counter() - t0) / ITERS
     learner = bst._gbdt.learner
     out = {
