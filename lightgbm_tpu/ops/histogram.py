@@ -105,6 +105,10 @@ def _hist_chunk_from_env(default: int) -> int:
 HIST_CHUNK = _hist_chunk_from_env(2048)
 MASKED_HIST_CHUNK = _hist_chunk_from_env(8192)
 
+# Narrow-dtype one-hot compare in the masked kernels (int8/bf16 instead
+# of int32 — see _packed_onehot).  Kill-switch for on-chip A/B.
+NARROW_ONEHOT = _os.environ.get("LGBT_NARROW_ONEHOT", "1") != "0"
+
 
 def _coerce_dtype(input_dtype: str) -> str:
     """int8 means caller-side gradient quantization, which only the
@@ -313,7 +317,7 @@ def hist_multileaf(gb_t: jax.Array, vals: jax.Array, *, num_bins_padded: int,
 
 
 def _packed_onehot(gb_ref, g_, B, pack, bins_sub, out_dtype,
-                   bin_offset=0, bwin=0):
+                   bin_offset=0, bwin=0, narrow=False):
     """One-hot block for `pack` features sharing the 128 lanes: feature
     s of the pack occupies lanes [s·bins_sub, (s+1)·bins_sub), so ONE
     [M, Ck] @ [Ck, B] matmul histograms all `pack` features — the fix
@@ -330,8 +334,44 @@ def _packed_onehot(gb_ref, g_, B, pack, bins_sub, out_dtype,
     one 128-lane tile — the full [G, Mp, 256] block double-buffers to
     16 MB and overflows VMEM on multi-feature-block grids).  B here is
     the WINDOW width (the out block's lane count), not the full bin
-    count."""
+    count.
+
+    narrow: run the [Ck, B] equality in the NARROWEST dtype holding the
+    bin domain instead of int32.  This compare (plus its cast to the
+    matmul operand dtype) is the dominant per-pass cost at north-star
+    shape — the pass is VPU-bound, not MXU-bound: K=1 costs 207 ms vs
+    214 ms at K=128 (profile_hotpath_measured.json).  int8 tiles are
+    (32, 128) = 4x the int32 lane volume per op, and select replaces
+    the bool→int32→int8 double cast.  Exactness: every shifted operand
+    (bin + s·bins_sub, lane + bwin, both shifted by -128) lies in ONE
+    256-wide window, so mod-256 int8 equality IS value equality — the
+    caller sets narrow only when the full bin count <= 256."""
     iota = jax.lax.broadcasted_iota(jnp.int32, (1, B), 1) + bwin
+    if narrow and out_dtype == jnp.int8:
+        # int8 compare domain: x - 128 for every operand
+        iota8 = (iota - 128).astype(jnp.int8)
+        acc = None
+        for s in range(pack):
+            gb = gb_ref[0, g_ * pack + s, :]
+            if gb.dtype == jnp.int8:
+                # stored value-128 already; the pack shift cannot
+                # overflow: value-128 < bins_sub-128 <= -64, shift <= 96
+                if s:
+                    gb = gb + jnp.int8(s * bins_sub)
+            else:
+                gb = (gb + (s * bins_sub - 128)).astype(jnp.int8)
+            cmp = gb[:, None] == iota8
+            acc = cmp if acc is None else acc | cmp
+        return jnp.where(acc, jnp.int8(1), jnp.int8(0))
+    if narrow and out_dtype == jnp.bfloat16:
+        # bf16 tiles are (16, 128) = 2x int32; bins <= 255 are exact
+        iotab = iota.astype(jnp.bfloat16)
+        acc = None
+        for s in range(pack):
+            gb = gb_ref[0, g_ * pack + s, :].astype(jnp.int32) + bin_offset
+            cmp = (gb + (s * bins_sub)).astype(jnp.bfloat16)[:, None] == iotab
+            acc = cmp if acc is None else acc | cmp
+        return acc.astype(jnp.bfloat16)
     acc = None
     for s in range(pack):
         gb = gb_ref[0, g_ * pack + s, :].astype(jnp.int32) + bin_offset
@@ -345,7 +385,7 @@ def _packed_onehot(gb_ref, g_, B, pack, bins_sub, out_dtype,
 def _hist_kernel_masked(sl_ref, gb_ref, lid_ref, gh_ref, out_ref, *,
                         B: int, K: int, input_dtype, pack: int = 1,
                         bins_sub: int = 0, bin_offset: int = 0,
-                        windowed: bool = False):
+                        windowed: bool = False, narrow: bool = False):
     """Multi-leaf histogram with the leaf masks built in VMEM.
 
     sl_ref : [Kp, 128] int32 — small-leaf id per slot, replicated across
@@ -397,7 +437,7 @@ def _hist_kernel_masked(sl_ref, gb_ref, lid_ref, gh_ref, out_ref, *,
     G = gb_ref.shape[1]
     for g_ in range(G // pack):
         oh = _packed_onehot(gb_ref, g_, Bs, pack, bins_sub, input_dtype,
-                            bin_offset, bwin)
+                            bin_offset, bwin, narrow)
         out_ref[0, g_, :, :] += jnp.dot(
             vals, oh, preferred_element_type=jnp.float32, precision=prec)
 
@@ -405,7 +445,7 @@ def _hist_kernel_masked(sl_ref, gb_ref, lid_ref, gh_ref, out_ref, *,
 def _hist_kernel_masked_q(sl_ref, gb_ref, lid_ref, ghq_ref, out_ref, *,
                           B: int, K: int, pack: int = 1,
                           bins_sub: int = 0, bin_offset: int = 0,
-                          windowed: bool = False):
+                          windowed: bool = False, narrow: bool = False):
     """int8-quantized variant of _hist_kernel_masked: vals and one-hot
     are int8 and the contraction accumulates exactly in int32 (v5e runs
     int8 MXU matmuls at 2x bf16 throughput).  ghq rows are pre-quantized
@@ -447,7 +487,7 @@ def _hist_kernel_masked_q(sl_ref, gb_ref, lid_ref, ghq_ref, out_ref, *,
     G = gb_ref.shape[1]
     for g_ in range(G // pack):
         oh = _packed_onehot(gb_ref, g_, Bs, pack, bins_sub, jnp.int8,
-                            bin_offset, bwin)
+                            bin_offset, bwin, narrow)
         out_ref[0, g_, :, :] += jnp.dot(
             vals, oh, preferred_element_type=jnp.int32)
 
@@ -621,12 +661,16 @@ def hist_multileaf_masked(gb_t: jax.Array, lid: jax.Array, gh8: jax.Array,
         h = h.transpose(0, 1, 3, 2, 4).reshape(Fg, Mp, bins_sub)
         return jnp.pad(h, ((0, 0), (0, 0), (0, B - bins_sub)))[:F]
 
+    # narrow compare is exact only while every operand fits one 256-wide
+    # window (see _packed_onehot); B > 256 would alias mod 256
+    narrow = NARROW_ONEHOT and B <= 256
+
     if quant:
         ghq, sg, sh = _quantize_gh(gh8)
         out = pl.pallas_call(
             functools.partial(_hist_kernel_masked_q, B=B, K=K, pack=pack,
                               bins_sub=bins_sub, bin_offset=bin_offset,
-                              windowed=nB > 1),
+                              windowed=nB > 1, narrow=narrow),
             out_shape=jax.ShapeDtypeStruct((Fg // G, Gp, Mp, B), jnp.int32),
             grid=grid,
             in_specs=in_specs,
@@ -642,7 +686,8 @@ def hist_multileaf_masked(gb_t: jax.Array, lid: jax.Array, gh8: jax.Array,
     out = pl.pallas_call(
         functools.partial(_hist_kernel_masked, B=B, K=K, input_dtype=dt,
                           pack=pack, bins_sub=bins_sub,
-                          bin_offset=bin_offset, windowed=nB > 1),
+                          bin_offset=bin_offset, windowed=nB > 1,
+                          narrow=narrow),
         out_shape=jax.ShapeDtypeStruct((Fg // G, Gp, Mp, B), jnp.float32),
         grid=grid,
         in_specs=in_specs,

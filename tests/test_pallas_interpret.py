@@ -181,7 +181,7 @@ def test_hist_masked_int8_feature_packing():
                                rtol=0, atol=1e-4)
 
 
-@pytest.mark.parametrize("input_dtype", ["float32", "int8"])
+@pytest.mark.parametrize("input_dtype", ["float32", "bfloat16", "int8"])
 def test_hist_masked_int8_stored_bins(input_dtype):
     """int8-STORED bins (value-128 HBM layout, the Expo-scale memory fix)
     must histogram identically to int32 storage, through both the f32/bf16
@@ -215,3 +215,64 @@ def test_hist_masked_int8_stored_bins(input_dtype):
         input_dtype=input_dtype)
     np.testing.assert_allclose(np.asarray(h_i8x), np.asarray(h_i32),
                                rtol=0, atol=1e-4)
+
+
+def test_hist_masked_bf16_narrow_onehot():
+    """The bf16 masked kernel with the narrow (bf16-domain) one-hot
+    compare: bin values <= 255 are exact in bf16, so the pallas result
+    must match the XLA bf16 formulation bit-for-bit in the one-hot and
+    to bf16 summation tolerance in the totals."""
+    rng, gb = _rand(2051, 5, 255, seed=9)
+    B = 256
+    lid = rng.randint(0, 10, size=2051).astype(np.int32)
+    gh8 = np.zeros((8, 2051), np.float32)
+    gh8[0] = rng.randn(2051)
+    gh8[1] = rng.rand(2051)
+    gh8[2] = 1.0
+    sl = np.array([1, -1, 9, 4], np.int32)
+    args = (jnp.asarray(gb), jnp.asarray(lid), jnp.asarray(gh8),
+            jnp.asarray(sl))
+    h_pl = hist_multileaf_masked(*args, num_bins_padded=B,
+                                 backend="pallas", input_dtype="bfloat16",
+                                 interpret=True)
+    h_x = hist_multileaf_masked(*args, num_bins_padded=B,
+                                backend="xla", input_dtype="bfloat16")
+    assert h_pl.shape == (4, 5, 3, B)
+    np.testing.assert_allclose(np.asarray(h_pl), np.asarray(h_x),
+                               rtol=2e-2, atol=2e-2)
+    # counts (bf16 sums of 0/1) agree exactly between the formulations
+    np.testing.assert_array_equal(np.asarray(h_pl)[:, :, 2],
+                                  np.asarray(h_x)[:, :, 2])
+    assert np.asarray(h_pl)[1].max() == 0.0
+
+
+@pytest.mark.parametrize("input_dtype", ["bfloat16", "int8"])
+def test_hist_masked_int8_stored_packed_bins(input_dtype):
+    """int8-STORED bins combined with feature packing: the narrow
+    compare applies the pack shift IN int8 (`gb + s*bins_sub` on the
+    value-128 layout), whose no-overflow bound (stored <= bins_sub-129,
+    shift <= 128-bins_sub... <= 96) is the most delicate branch of
+    _packed_onehot — pin it against int32 storage through XLA."""
+    rng, gb = _rand(2500, 33, 60, seed=21)      # 60 bins -> bins_sub=64
+    B = 128
+    lid = rng.randint(0, 6, size=2500).astype(np.int32)
+    gh8 = np.zeros((8, 2500), np.float32)
+    gh8[0] = rng.randn(2500)
+    gh8[1] = rng.rand(2500)
+    gh8[2] = 1.0
+    sl = np.array([0, 5, -1, 3], np.int32)
+    gb8 = (gb.astype(np.int16) - 128).astype(np.int8)
+    h_pl = hist_multileaf_masked(
+        jnp.asarray(gb8), jnp.asarray(lid), jnp.asarray(gh8),
+        jnp.asarray(sl), num_bins_padded=B, backend="pallas",
+        input_dtype=input_dtype, interpret=True, max_num_bin=60)
+    h_x = hist_multileaf_masked(
+        jnp.asarray(gb), jnp.asarray(lid), jnp.asarray(gh8),
+        jnp.asarray(sl), num_bins_padded=B, backend="xla",
+        input_dtype=input_dtype)
+    tol = 2e-2 if input_dtype == "bfloat16" else 1e-4
+    np.testing.assert_allclose(np.asarray(h_pl), np.asarray(h_x),
+                               rtol=0, atol=tol)
+    np.testing.assert_array_equal(np.asarray(h_pl)[:, :, 2],
+                                  np.asarray(h_x)[:, :, 2])
+    assert np.asarray(h_pl)[2].max() == 0.0
