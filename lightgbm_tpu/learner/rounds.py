@@ -169,7 +169,7 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
     h0 = hist_multileaf_masked(binsf, lid0, gh8,
                                jnp.zeros(1, jnp.int32), num_bins_padded=B,
                                backend=backend, input_dtype=input_dtype,
-                               max_num_bin=max_num_bin)
+                               max_num_bin=max_num_bin, num_leaves=L)
     hist0 = _psum(h0[0], data_axis)                     # [F, 3, B]
     sum_g = jnp.sum(hist0[0, 0, :])
     sum_h = jnp.sum(hist0[0, 1, :])
@@ -316,7 +316,8 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
         def hist_tiered(slv, dk, Kc):
             full_call = functools.partial(
                 hist_multileaf_masked, num_bins_padded=B, backend=backend,
-                input_dtype=input_dtype, max_num_bin=max_num_bin)
+                input_dtype=input_dtype, max_num_bin=max_num_bin,
+                num_leaves=L)
             if Kc <= K_SMALL:
                 return full_call(binsf, leaf_id2, gh8, slv)
 

@@ -445,7 +445,8 @@ def _hist_kernel_masked(sl_ref, gb_ref, lid_ref, gh_ref, out_ref, *,
 def _hist_kernel_masked_q(sl_ref, gb_ref, lid_ref, ghq_ref, out_ref, *,
                           B: int, K: int, pack: int = 1,
                           bins_sub: int = 0, bin_offset: int = 0,
-                          windowed: bool = False, narrow: bool = False):
+                          windowed: bool = False, narrow: bool = False,
+                          narrow_lid: bool = False):
     """int8-quantized variant of _hist_kernel_masked: vals and one-hot
     are int8 and the contraction accumulates exactly in int32 (v5e runs
     int8 MXU matmuls at 2x bf16 throughput).  ghq rows are pre-quantized
@@ -469,21 +470,40 @@ def _hist_kernel_masked_q(sl_ref, gb_ref, lid_ref, ghq_ref, out_ref, *,
     def _init():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    lid = lid_ref[0, :]
-    sl = sl_ref[:K, 0:1]
-    # elementwise mask work stays in i32 (Mosaic has neither int8
-    # 'arith.muli' nor an i1->(32,128)-tile relayout on this target);
-    # only the matmul OPERANDS are int8 — that is where the 2x
-    # throughput lives, and i32->i8 truncation is a supported cast
-    m = (lid[None, :] == sl).astype(jnp.int32)           # [K, Ck]
-    vals32 = jnp.concatenate([m * ghq_ref[0:1, :], m * ghq_ref[1:2, :],
-                              m * ghq_ref[2:3, :]], axis=0)  # [3K, Ck]
     Mp = out_ref.shape[2]
-    if Mp > 3 * K:
-        vals32 = jnp.concatenate(
-            [vals32, jnp.zeros((Mp - 3 * K, vals32.shape[1]), jnp.int32)],
-            axis=0)
-    vals = vals32.astype(jnp.int8)
+    if narrow_lid:
+        # leaf-id compare and mask-select natively in int8 ((32, 128)
+        # VPU tiles = 4x the int32 lane volume; a where replaces the
+        # int32 multiply + narrowing cast).  Exact while leaf ids fit
+        # one 256-window after the -128 shift: the caller gates on
+        # num_leaves <= 255, so live ids map to [-128, 126] and the
+        # empty-slot sentinel -1 wraps to 127, which no live id takes.
+        # Padded rows (lid sentinel -2 wraps to 126 = id 254's code)
+        # carry all-zero ghq rows, so an aliased mask hit contributes 0.
+        lid8 = (lid_ref[0, :] - 128).astype(jnp.int8)
+        sl8 = (sl_ref[:K, 0:1] - 128).astype(jnp.int8)
+        cmp = lid8[None, :] == sl8                       # [K, Ck]
+        z = jnp.int8(0)
+        parts = [jnp.where(cmp, ghq_ref[r:r + 1, :].astype(jnp.int8), z)
+                 for r in range(3)]
+        if Mp > 3 * K:
+            parts.append(jnp.zeros((Mp - 3 * K, cmp.shape[1]), jnp.int8))
+        vals = jnp.concatenate(parts, axis=0)            # [Mp, Ck] int8
+    else:
+        lid = lid_ref[0, :]
+        sl = sl_ref[:K, 0:1]
+        # elementwise mask work stays in i32 (Mosaic has neither int8
+        # 'arith.muli' nor an i1->(32,128)-tile relayout on this target);
+        # only the matmul OPERANDS are int8 — that is where the 2x
+        # throughput lives, and i32->i8 truncation is a supported cast
+        m = (lid[None, :] == sl).astype(jnp.int32)       # [K, Ck]
+        vals32 = jnp.concatenate([m * ghq_ref[0:1, :], m * ghq_ref[1:2, :],
+                                  m * ghq_ref[2:3, :]], axis=0)  # [3K, Ck]
+        if Mp > 3 * K:
+            vals32 = jnp.concatenate(
+                [vals32, jnp.zeros((Mp - 3 * K, vals32.shape[1]),
+                                   jnp.int32)], axis=0)
+        vals = vals32.astype(jnp.int8)
     G = gb_ref.shape[1]
     for g_ in range(G // pack):
         oh = _packed_onehot(gb_ref, g_, Bs, pack, bins_sub, jnp.int8,
@@ -520,13 +540,14 @@ def packed_bins_layout(max_num_bin: int, num_bins_padded: int):
 
 @functools.partial(jax.jit, static_argnames=("num_bins_padded", "backend",
                                              "input_dtype", "interpret",
-                                             "max_num_bin"))
+                                             "max_num_bin", "num_leaves"))
 def hist_multileaf_masked(gb_t: jax.Array, lid: jax.Array, gh8: jax.Array,
                           sl: jax.Array, *, num_bins_padded: int,
                           backend: str = "xla",
                           input_dtype: str = "float32",
                           interpret: bool = False,
-                          max_num_bin: int = 0) -> jax.Array:
+                          max_num_bin: int = 0,
+                          num_leaves: int = 0) -> jax.Array:
     """Histogram K leaves in one pass, masks built on the fly.
 
     gb_t: [F, C] int bins; lid: [C] int32 leaf ids; gh8: [8, C] f32
@@ -535,6 +556,12 @@ def hist_multileaf_masked(gb_t: jax.Array, lid: jax.Array, gh8: jax.Array,
 
     max_num_bin (static; 0 = unknown) enables feature packing on the
     pallas path when all bins fit a 16/32/64-lane sub-block.
+
+    num_leaves (static; 0 = unknown): the leaf COUNT — an EXCLUSIVE
+    bound on leaf ids (ids < num_leaves; an id equal to num_leaves=255
+    would wrap onto the empty-slot sentinel).  When <= 255 the
+    quantized kernel runs the leaf-id mask compare in int8 (see
+    _hist_kernel_masked_q narrow_lid).
 
     input_dtype "int8" (the validated bench default) selects per-pass symmetric
     gradient quantization with exact int32 accumulation: counts are
@@ -662,15 +689,19 @@ def hist_multileaf_masked(gb_t: jax.Array, lid: jax.Array, gh8: jax.Array,
         return jnp.pad(h, ((0, 0), (0, 0), (0, B - bins_sub)))[:F]
 
     # narrow compare is exact only while every operand fits one 256-wide
-    # window (see _packed_onehot); B > 256 would alias mod 256
+    # window (see _packed_onehot); B > 256 would alias mod 256.  The
+    # leaf-id compare narrows under the same window argument when the
+    # caller states num_leaves <= 255 (0 = unknown, stay wide).
     narrow = NARROW_ONEHOT and B <= 256
+    narrow_lid = NARROW_ONEHOT and 0 < num_leaves <= 255
 
     if quant:
         ghq, sg, sh = _quantize_gh(gh8)
         out = pl.pallas_call(
             functools.partial(_hist_kernel_masked_q, B=B, K=K, pack=pack,
                               bins_sub=bins_sub, bin_offset=bin_offset,
-                              windowed=nB > 1, narrow=narrow),
+                              windowed=nB > 1, narrow=narrow,
+                              narrow_lid=narrow_lid),
             out_shape=jax.ShapeDtypeStruct((Fg // G, Gp, Mp, B), jnp.int32),
             grid=grid,
             in_specs=in_specs,

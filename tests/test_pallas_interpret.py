@@ -276,3 +276,32 @@ def test_hist_masked_int8_stored_packed_bins(input_dtype):
     np.testing.assert_array_equal(np.asarray(h_pl)[:, :, 2],
                                   np.asarray(h_x)[:, :, 2])
     assert np.asarray(h_pl)[2].max() == 0.0
+
+
+def test_hist_masked_narrow_lid_aliasing():
+    """The int8 leaf-id compare (quant kernel, num_leaves<=255): padded
+    rows carry lid sentinel -2, which wraps to the same int8 code as
+    leaf 254 — the kernel stays exact because padded ghq rows are zero.
+    Stress exactly that: C > chunk (real padding), a slot holding leaf
+    254, empty -1 slots, and num_leaves at the 255 gate boundary."""
+    rng, gb = _rand(9000, 4, 200, seed=31)      # 9000 > 8192 chunk -> pad
+    B = 256
+    lid = rng.randint(0, 255, size=9000).astype(np.int32)
+    lid[:50] = 254                               # leaf 254 is live
+    gh8 = np.zeros((8, 9000), np.float32)
+    gh8[0] = rng.randn(9000)
+    gh8[1] = rng.rand(9000)
+    gh8[2] = 1.0
+    sl = np.array([254, -1, 7, 0], np.int32)
+    args = (jnp.asarray(gb), jnp.asarray(lid), jnp.asarray(gh8),
+            jnp.asarray(sl))
+    h_n = hist_multileaf_masked(*args, num_bins_padded=B, backend="pallas",
+                                input_dtype="int8", interpret=True,
+                                num_leaves=255)
+    h_x = hist_multileaf_masked(*args, num_bins_padded=B, backend="xla",
+                                input_dtype="int8")
+    np.testing.assert_allclose(np.asarray(h_n), np.asarray(h_x),
+                               rtol=0, atol=1e-4)
+    # leaf-254 slot counts exactly its rows (aliased pad rows add zero)
+    assert np.asarray(h_n)[0, 0, 2].sum() == (lid == 254).sum()
+    assert np.asarray(h_n)[1].max() == 0.0
