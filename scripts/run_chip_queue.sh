@@ -1,0 +1,34 @@
+#!/bin/bash
+# Round-4 chip measurement queue.  Run when the TPU tunnel is alive;
+# each stage writes its own artifact and a stage marker, so a mid-queue
+# tunnel wedge loses only the running stage (rerun resumes after the
+# last marker).  Order = VERDICT priority: validate the new kernels
+# first, then the never-measured at-scale configs, then refreshes.
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+MARK=.bench/chip_queue_done
+mkdir -p .bench
+touch "$MARK"
+
+stage() {  # stage <name> <cmd...>  (stdout tees to .bench/<name>.log)
+  local name=$1; shift
+  if grep -qx "$name" "$MARK"; then echo "== $name: done, skip"; return 0; fi
+  echo "== $name: $(date +%H:%M:%S)"
+  if timeout 7200 "$@" 2>&1 | tee ".bench/$name.log"; then
+    echo "$name" >> "$MARK"; return 0
+  else echo "!! $name FAILED (tunnel?)"; return 1; fi
+}
+
+# 1. kernel-level profile at HEAD (narrow one-hot in)
+stage profile python scripts/profile_hotpath.py || exit 1
+# 2. short full-shape A/B: narrow on (default) vs off
+stage bench_narrow_on  env BENCH_ITERS=12 python bench.py || exit 1
+stage bench_narrow_off env LGBT_NARROW_ONEHOT=0 BENCH_ITERS=12 python bench.py || exit 1
+# 3. never-measured at-scale configs (VERDICT missing #2)
+stage ltr  python scripts/run_ltr_scale.py || exit 1
+stage expo python scripts/run_expo_scale.py || exit 1
+# 4. wide-feature sweep rerun (63-bin packing + narrow kernels)
+stage shapes python scripts/run_shape_sweep.py || exit 1
+# 5. full 500-iter north-star refresh at HEAD (slowest last)
+stage northstar python scripts/run_northstar.py || exit 1
+echo "ALL STAGES DONE $(date +%H:%M:%S)"
