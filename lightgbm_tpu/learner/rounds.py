@@ -43,7 +43,7 @@ from .common import (make_split_kw, padded_bin_count, sentinel_bins_t,
                      use_parent_hist_cache)
 from .fused import TreeArrays, tree_arrays_to_host
 from ..ops.histogram import hist_multileaf_masked
-from ..ops.lookup import select_bin_by_feature, table_lookup
+from ..ops.partition import partition_rows
 from ..ops.split import best_split, leaf_output
 from ..tree import Tree
 
@@ -142,10 +142,8 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
     # shape); every consumer widens in fused ops / kernel VMEM
     if bins.dtype == jnp.int8:
         binsf = bins
-        bin_off = 128
     else:
         binsf = bins.astype(jnp.int32)
-        bin_off = 0
 
     def find_best_batch(hists, sums):
         """hists [K2, F, 3, B], sums [K2, 3] → packed recs [K2, 11] with the
@@ -232,11 +230,12 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
 
         # ---- partition all rows in one pass -------------------------------
         # per-LEAF lookup of (split feature, threshold, is-cat, new leaf)
-        # as ONE one-hot matmul (ops/lookup.py): XLA's [Nloc] table gather
-        # runs at <1 GB/s on TPU and cost more than the histogram kernel
-        # (65 ms/table at N=4M vs 5 ms for the matmul, which is exact for
-        # integer-valued f32 tables; new_leaf > 0 ⟺ leaf splits, leaf 0
-        # is never a NEW leaf)
+        # then the per-row bin read and move — fused in one pallas pass
+        # (ops/partition.py; XLA fallback composes the one-hot matmuls of
+        # ops/lookup.py there).  XLA's [Nloc] table gather runs at
+        # <1 GB/s on TPU and cost more than the histogram kernel
+        # (65 ms/table at N=4M); new_leaf > 0 ⟺ leaf splits, leaf 0
+        # is never a NEW leaf, so 0 table rows mean "stay"
         tbl_idx = jnp.where(do, pl_, L)                      # drop-slot L
         zeros = jnp.zeros(L + 1, jnp.float32)
         tbl = jnp.stack([
@@ -245,16 +244,8 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
             zeros.at[tbl_idx].set(catf.astype(jnp.float32), mode="drop"),
             zeros.at[tbl_idx].set(new_leaf.astype(jnp.float32),
                                   mode="drop")])
-        r = table_lookup(tbl, leaf_id, num_slots=L + 1)      # [4, Nloc]
-        fi = r[0].astype(jnp.int32)
-        ti = r[1].astype(jnp.int32)
-        ci = r[2] > 0
-        nli = r[3].astype(jnp.int32)
-        # every row matches exactly one feature, so the stored-offset
-        # correction is a single +128 on the selected value
-        vi = select_bin_by_feature(binsf, fi) + bin_off
-        gl = jnp.where(ci, vi == ti, vi <= ti)
-        leaf_id2 = jnp.where((nli > 0) & ~gl, nli, leaf_id)
+        leaf_id2 = partition_rows(binsf, leaf_id, tbl, num_slots=L + 1,
+                                  backend=backend, num_bins_padded=B)
 
         # ---- tree arrays (batched Tree::Split) ----------------------------
         nodei = jnp.where(do, node, L - 1)                   # drop idx

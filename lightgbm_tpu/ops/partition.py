@@ -1,0 +1,162 @@
+"""Fused row partition for the rounds learner.
+
+One boosting round reassigns every row: look up its leaf's split
+(feature, threshold, is-categorical, new-leaf id), read the row's bin of
+that feature, and move the row right when the split sends it there.  The
+reference does this as random-access loads per row
+(data_partition.hpp:80-130, dense_bin.hpp:67-120); XLA:TPU expresses it
+as two one-hot matmuls plus elementwise selects (ops/lookup.py), which
+materialize [N, ·] one-hots in HBM — measured 41 ms/round at the
+north-star shape (profile_hotpath_measured.json), a quarter of the
+iteration once the histogram kernels are narrow.
+
+The pallas kernel fuses the whole step in VMEM per row-chunk:
+
+- ONE int8 [8, S] @ [S, Ck] matmul performs ALL FOUR table lookups: the
+  slot one-hot is built with the narrow int8 compare (ids - 128, exact
+  while S <= 256 — same window argument as ops/histogram._packed_onehot)
+  and the table rows carry threshold-128, is-cat, new-leaf-128 and the
+  split feature as two base-128 digits (f_hi, f_lo), every entry in
+  int8 range, each product exact, int32 accumulation of a single
+  non-zero per column.
+- the row's bin of its split feature is a compare-reduce over the
+  feature axis of the SAME bins block the histogram kernel streams
+  (no [N, F] one-hot ever leaves VMEM).
+- the left/right decision and the new leaf id are elementwise.
+
+HBM traffic collapses to: bins read once, lid read once, lid2 written
+once.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+import os as _os
+
+from .histogram import MASKED_HIST_CHUNK
+from .lookup import table_lookup, select_bin_by_feature
+
+# kill-switch for on-chip A/B: 0 routes every call to the XLA composition
+FUSED_PARTITION = _os.environ.get("LGBT_FUSED_PARTITION", "1") != "0"
+
+
+def _partition_kernel(tbl_ref, gb_ref, lid_ref, out_ref, *, S: int,
+                      bin_offset: int):
+    """tbl_ref [8, S] int8 rows (f_hi, f_lo, thr-128, cat, nli-128, 0..);
+    gb_ref [1, F, Ck] int bins (int8 holds value-128 when bin_offset);
+    lid_ref/out_ref [1, Ck] int32."""
+    lidv = lid_ref[0, :]                                     # [Ck] i32
+    lid8 = (lidv - 128).astype(jnp.int8)
+    iota8 = (jax.lax.broadcasted_iota(jnp.int32, (S, 1), 0)
+             - 128).astype(jnp.int8)
+    oh = jnp.where(iota8 == lid8[None, :], jnp.int8(1), jnp.int8(0))
+    r = jnp.dot(tbl_ref[:, :], oh,
+                preferred_element_type=jnp.int32)            # [8, Ck]
+    fi = r[0] * 128 + r[1]
+    ti = r[2] + 128
+    ci = r[3] > 0
+    nli = r[4] + 128
+
+    gb = gb_ref[0]                                           # [F, Ck]
+    F = gb.shape[0]
+    iof = jax.lax.broadcasted_iota(jnp.int32, (F, 1), 0)
+    # exactly one feature row matches per column, so the sum IS the
+    # selected bin; padded feature rows are never selected (fi < F)
+    vi = jnp.sum(jnp.where(fi[None, :] == iof, gb.astype(jnp.int32), 0),
+                 axis=0) + bin_offset                        # [Ck]
+    gl = jnp.where(ci, vi == ti, vi <= ti)
+    out_ref[0, :] = jnp.where((nli > 0) & ~gl, nli, lidv)
+
+
+@functools.partial(jax.jit, static_argnames=("num_slots", "interpret"))
+def _partition_pallas(tbl8, gb_t, lid, *, num_slots: int,
+                      interpret: bool = False):
+    from jax.experimental import pallas as pl
+
+    F, C = gb_t.shape
+    bin_offset = 128 if gb_t.dtype == jnp.int8 else 0
+    isz = jnp.dtype(gb_t.dtype).itemsize
+    # sublane-align the feature axis (int8 tiles are (32, 128)); padded
+    # feature rows are never selected — fi always names a real feature
+    sub = 32 if isz == 1 else 8
+    if F % sub:
+        gb_t = jnp.pad(gb_t, ((0, sub - F % sub), (0, 0)))
+        F = gb_t.shape[0]
+    # VMEM model: bins block F*Ck*isz, its int32 widen F*Ck*4, the
+    # [S, Ck] one-hot — keep under ~10 MB
+    Ck = min(C, MASKED_HIST_CHUNK)
+    per_row = F * (isz + 4) + num_slots
+    Ck = min(Ck, max(512, (int(10e6) // per_row) // 128 * 128))
+    if C % Ck:
+        pad = Ck - C % Ck
+        gb_t = jnp.pad(gb_t, ((0, 0), (0, pad)))
+        # pad rows sit in slot 0; their lid2 is discarded by the caller
+        lid = jnp.pad(lid, (0, pad))
+        C += pad
+    grid = (C // Ck,)
+    out = pl.pallas_call(
+        functools.partial(_partition_kernel, S=num_slots,
+                          bin_offset=bin_offset),
+        out_shape=jax.ShapeDtypeStruct((1, C), jnp.int32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((8, num_slots), lambda k: (0, 0)),
+            pl.BlockSpec((1, F, Ck), lambda k: (0, 0, k)),
+            pl.BlockSpec((1, Ck), lambda k: (0, k)),
+        ],
+        out_specs=pl.BlockSpec((1, Ck), lambda k: (0, k)),
+        interpret=interpret,
+    )(tbl8, gb_t[None], lid[None, :])
+    return out[0]
+
+
+def partition_rows(bins_fn: jax.Array, leaf_id: jax.Array,
+                   tbl: jax.Array, *, num_slots: int, backend: str = "xla",
+                   num_bins_padded: int = 0,
+                   interpret: bool = False) -> jax.Array:
+    """New leaf id per row after this round's splits.
+
+    bins_fn [F, N] int bins (int8 = value-128 storage); leaf_id [N]
+    int32 in [0, num_slots-1); tbl [4, num_slots] f32 rows
+    (split feature, threshold bin, is-categorical, new leaf id) indexed
+    by leaf — row values of non-splitting leaves must be 0 (new leaf 0
+    means "stay", leaf 0 is never a NEW leaf).
+
+    Routes to the fused pallas kernel when the int8 encodings are exact
+    (slots <= 256, thresholds < 256, feature ids < 2^14 i.e. two base-128
+    digits); otherwise composes the XLA one-hot lookups.
+    """
+    F = bins_fn.shape[0]
+    # the kernel holds ALL F feature rows (bins + their int32 widen) per
+    # block — the VMEM model must admit Ck >= 512, which bounds F at
+    # ~3.8k int8 / ~2.4k int32 features; larger goes to the XLA path
+    isz = jnp.dtype(bins_fn.dtype).itemsize
+    f_fits = 512 * (F * (isz + 4) + 256) <= int(10e6)
+    fits = (FUSED_PARTITION and backend == "pallas" and num_slots <= 256
+            and 0 < num_bins_padded <= 256 and f_fits)
+    if not fits:
+        r = table_lookup(tbl, leaf_id, num_slots=num_slots)
+        fi = r[0].astype(jnp.int32)
+        ti = r[1].astype(jnp.int32)
+        ci = r[2] > 0
+        nli = r[3].astype(jnp.int32)
+        off = 128 if bins_fn.dtype == jnp.int8 else 0
+        vi = select_bin_by_feature(bins_fn, fi) + off
+        gl = jnp.where(ci, vi == ti, vi <= ti)
+        return jnp.where((nli > 0) & ~gl, nli, leaf_id)
+
+    S = 256 if num_slots > 128 else 128          # lane-pad the slot axis
+    feat = tbl[0].astype(jnp.int32)
+    thr = tbl[1].astype(jnp.int32)
+    cat = tbl[2].astype(jnp.int32)
+    nli = tbl[3].astype(jnp.int32)
+    rows = jnp.stack([feat // 128, feat % 128, thr - 128, cat, nli - 128,
+                      jnp.zeros_like(feat), jnp.zeros_like(feat),
+                      jnp.zeros_like(feat)])
+    tbl8 = jnp.pad(rows, ((0, 0), (0, S - num_slots))).astype(jnp.int8)
+    N = leaf_id.shape[0]
+    return _partition_pallas(tbl8, bins_fn, leaf_id, num_slots=S,
+                             interpret=interpret)[:N]

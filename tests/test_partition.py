@@ -1,0 +1,96 @@
+"""Fused partition kernel (ops/partition.py) vs the XLA composition.
+
+The pallas path encodes all four per-leaf lookups into one int8 matmul
+(base-128 feature digits, value-128 thresholds/leaf ids) — these tests
+pin that encoding against the plain XLA path across the delicate cases:
+categorical equality splits, non-splitting leaves (zero table rows),
+feature ids past one int8 digit, int8-stored bins, and row-chunk padding.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from lightgbm_tpu.ops.partition import partition_rows
+
+pytestmark = pytest.mark.quick
+
+
+def _case(n, f, b, L, seed, cat_frac=0.3, int8_store=False):
+    rng = np.random.RandomState(seed)
+    gb = rng.randint(0, b, size=(f, n)).astype(np.int32)
+    lid = rng.randint(0, L, size=n).astype(np.int32)
+    # roughly half the leaves split this round
+    feat = np.zeros(L + 1, np.float32)
+    thr = np.zeros(L + 1, np.float32)
+    cat = np.zeros(L + 1, np.float32)
+    nli = np.zeros(L + 1, np.float32)
+    for leaf in range(0, L, 2):
+        feat[leaf] = rng.randint(0, f)
+        thr[leaf] = rng.randint(0, b)
+        cat[leaf] = rng.rand() < cat_frac
+        nli[leaf] = rng.randint(1, L)        # any non-zero target id
+    tbl = jnp.asarray(np.stack([feat, thr, cat, nli]))
+    bins = (gb.astype(np.int16) - 128).astype(np.int8) if int8_store else gb
+    return jnp.asarray(bins), jnp.asarray(lid), tbl
+
+
+@pytest.mark.parametrize("n,f,b,L,seed,int8_store", [
+    (4097, 9, 250, 255, 0, False),     # chunk padding, 255-leaf table
+    (3000, 200, 250, 64, 1, False),    # feature ids need two int8 digits
+    (2500, 37, 250, 255, 2, True),     # int8-stored bins (value-128)
+    (2000, 5, 60, 31, 3, False),       # small tree, small bins
+])
+def test_partition_pallas_matches_xla(n, f, b, L, seed, int8_store):
+    bins, lid, tbl = _case(n, f, b, L, seed, int8_store=int8_store)
+    out_x = partition_rows(bins, lid, tbl, num_slots=L + 1, backend="xla",
+                           num_bins_padded=256)
+    out_p = partition_rows(bins, lid, tbl, num_slots=L + 1,
+                           backend="pallas", num_bins_padded=256,
+                           interpret=True)
+    np.testing.assert_array_equal(np.asarray(out_x), np.asarray(out_p))
+    # rows of non-splitting (odd) leaves never move
+    odd = np.asarray(lid) % 2 == 1
+    np.testing.assert_array_equal(np.asarray(out_p)[odd],
+                                  np.asarray(lid)[odd])
+
+
+def test_partition_categorical_equality():
+    """Categorical splits send ONLY the equal bin left; numerical send
+    <= threshold left (hand-checked tiny case)."""
+    bins = jnp.asarray(np.array([[3, 5, 3, 7]], np.int32))   # F=1, N=4
+    lid = jnp.asarray(np.zeros(4, np.int32))
+    # leaf 0 splits on feature 0 at bin 3; right child = leaf 1
+    for cat, expect in [(1.0, [0, 1, 0, 1]),    # equality: bins 3 stay
+                        (0.0, [0, 1, 0, 1])]:   # <=3: same here
+        tbl = jnp.asarray(np.array([[0, 0], [3, 0], [cat, 0], [1, 0]],
+                                   np.float32))
+        out = partition_rows(bins, lid, tbl, num_slots=2,
+                             backend="pallas", num_bins_padded=128,
+                             interpret=True)
+        np.testing.assert_array_equal(np.asarray(out), expect)
+    # distinguishing case: threshold 5, cat eq sends 3,3,7 right; num
+    # sends only 7 right
+    tbl_c = jnp.asarray(np.array([[0, 0], [5, 0], [1, 0], [1, 0]],
+                                 np.float32))
+    tbl_n = jnp.asarray(np.array([[0, 0], [5, 0], [0, 0], [1, 0]],
+                                 np.float32))
+    out_c = partition_rows(bins, lid, tbl_c, num_slots=2,
+                           backend="pallas", num_bins_padded=128,
+                           interpret=True)
+    out_n = partition_rows(bins, lid, tbl_n, num_slots=2,
+                           backend="pallas", num_bins_padded=128,
+                           interpret=True)
+    np.testing.assert_array_equal(np.asarray(out_c), [1, 0, 1, 1])
+    np.testing.assert_array_equal(np.asarray(out_n), [0, 0, 0, 1])
+
+
+def test_partition_fallback_gates():
+    """Shapes outside the int8 encodings route to the XLA path (and
+    agree with it trivially): > 256 slots, > 256 bins, huge F."""
+    bins, lid, tbl = _case(1000, 4, 50, 31, 7)
+    out_a = partition_rows(bins, lid, tbl, num_slots=32, backend="pallas",
+                           num_bins_padded=512)     # 512-bin gate -> XLA
+    out_b = partition_rows(bins, lid, tbl, num_slots=32, backend="xla",
+                           num_bins_padded=512)
+    np.testing.assert_array_equal(np.asarray(out_a), np.asarray(out_b))
