@@ -82,7 +82,7 @@ def main():
     for dt in ("int8", "bfloat16", "float32"):
         t = timeit(lambda dt=dt: hist_multileaf_masked(
             bins, lid, gh8, sl, num_bins_padded=B, backend=backend,
-            input_dtype=dt))
+            input_dtype=dt, num_leaves=255))
         util = 2 * macs / t / PEAK[dt]
         rec["kernels"][f"hist_multileaf_masked_K{K}_{dt}"] = {
             "ms": round(t * 1e3, 2),
@@ -94,7 +94,8 @@ def main():
 
     t1 = timeit(lambda: hist_multileaf_masked(
         bins, lid, gh8, jnp.asarray(np.arange(1, dtype=np.int32)),
-        num_bins_padded=B, backend=backend, input_dtype="int8"))
+        num_bins_padded=B, backend=backend, input_dtype="int8",
+        num_leaves=255))
     rec["kernels"]["hist_multileaf_masked_K1_root"] = {
         "ms": round(t1 * 1e3, 2)}
     print(f"hist_multileaf_masked K=1 (root): {t1*1e3:.1f} ms")
@@ -107,6 +108,21 @@ def main():
     t3 = timeit(lambda: table_lookup(tbl, lid, num_slots=256))
     rec["kernels"]["table_lookup_4x256"] = {"ms": round(t3 * 1e3, 2)}
     print(f"table_lookup [4,256]: {t3*1e3:.1f} ms")
+
+    # fused partition (replaces the two ops above + the move) — a
+    # realistic round table: every even leaf splits
+    from lightgbm_tpu.ops.partition import partition_rows
+    L = 255
+    ptbl = np.zeros((4, L + 1), np.float32)
+    ptbl[0, 0:L:2] = rng.randint(0, F, size=len(range(0, L, 2)))
+    ptbl[1, 0:L:2] = rng.randint(0, MB, size=len(range(0, L, 2)))
+    ptbl[3, 0:L:2] = rng.randint(1, L, size=len(range(0, L, 2)))
+    ptbl = jnp.asarray(ptbl)
+    t4 = timeit(lambda: partition_rows(bins, lid, ptbl, num_slots=L + 1,
+                                       backend=backend,
+                                       num_bins_padded=B))
+    rec["kernels"]["partition_rows_fused"] = {"ms": round(t4 * 1e3, 2)}
+    print(f"partition_rows (fused): {t4*1e3:.1f} ms")
 
     # full iteration at the same shape, bench-default precision
     import lightgbm_tpu as lgb
