@@ -621,13 +621,20 @@ def hist_multileaf_masked(gb_t: jax.Array, lid: jax.Array, gh8: jax.Array,
         Ck = min(Ck, 2048)
     else:
         # cap the big per-chunk transients — the [Mp, Ck] vals
-        # intermediate (int32 when quantizing, else the operand dtype)
-        # plus the [Ck, B] one-hot — at ~15 MB, the measured VMEM
-        # ceiling: Mp=256/Ck=16384 int32 vals (16.8 MB alone) OOMs on
-        # chip, Mp=384/Ck=8192 (12.6 + 2 MB) fits
+        # intermediate plus the [Ck, B] one-hot — at ~15 MB, the
+        # measured VMEM ceiling: Mp=256/Ck=16384 int32 vals (16.8 MB
+        # alone) OOMs on chip, Mp=384/Ck=8192 (12.6 + 2 MB) fits.  The
+        # narrow-lid quant path never materializes int32 vals (the
+        # where-select emits int8 directly), so its rows are ~4x
+        # cheaper and admit a larger LGBT_HIST_CHUNK.
         Mp_ = 8 * ((3 * K + 7) // 8)
         isz = jnp.dtype(input_dtype).itemsize
-        per_row = Mp_ * (4 if quant else isz) + B * (1 if quant else isz)
+        if quant:
+            vals_b = Mp_ * (1 if (NARROW_ONEHOT and 0 < num_leaves <= 255)
+                            else 4)
+            per_row = vals_b + B
+        else:
+            per_row = Mp_ * isz + B * isz
         Ck = min(Ck, max(512, (int(15e6) // per_row) // 128 * 128))
     if C % Ck:
         pad = Ck - C % Ck

@@ -25,6 +25,7 @@ stage profile python scripts/profile_hotpath.py || exit 1
 stage bench_narrow_on  env BENCH_ITERS=12 python bench.py || exit 1
 stage bench_narrow_off env LGBT_NARROW_ONEHOT=0 BENCH_ITERS=12 python bench.py || exit 1
 stage bench_part_off   env LGBT_FUSED_PARTITION=0 BENCH_ITERS=12 python bench.py || exit 1
+stage bench_chunk16k   env LGBT_HIST_CHUNK=16384 BENCH_ITERS=12 python bench.py || exit 1
 # 3. never-measured at-scale configs (VERDICT missing #2)
 stage ltr  python scripts/run_ltr_scale.py || exit 1
 stage expo python scripts/run_expo_scale.py || exit 1
