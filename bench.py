@@ -97,7 +97,23 @@ def main():
     }
     train = lgb.Dataset(X, y)
     bst = lgb.Booster(params, train)
-    for _ in range(WARMUP):          # compile + cache warm
+    try:
+        bst.update()                 # first update = pallas compile
+    except Exception:
+        # a Mosaic rejection of the narrow int8 kernels must not cost
+        # the round's bench: fall back to the wide-compare/XLA paths
+        # (flags are trace-time, so compiled traces are dropped and the
+        # Booster is rebuilt) and retrain from scratch
+        from lightgbm_tpu.ops.histogram import disable_narrow_onehot
+        from lightgbm_tpu.ops.partition import disable_fused_partition
+        print("narrow pallas kernels failed to compile; retrying with "
+              "LGBT_NARROW_ONEHOT=0 LGBT_FUSED_PARTITION=0",
+              file=sys.stderr)
+        disable_narrow_onehot()
+        disable_fused_partition()
+        bst = lgb.Booster(params, train)
+        bst.update()
+    for _ in range(WARMUP - 1):      # compile + cache warm
         bst.update()
     float(bst._gbdt.train_score.score.sum())   # drain warmup in-flight work
     t0 = time.perf_counter()

@@ -110,6 +110,17 @@ MASKED_HIST_CHUNK = _hist_chunk_from_env(8192)
 NARROW_ONEHOT = _os.environ.get("LGBT_NARROW_ONEHOT", "1") != "0"
 
 
+def disable_narrow_onehot():
+    """Runtime fallback if a TPU generation's Mosaic rejects an int8
+    vector op the narrow paths assume: flip the flag AND drop this
+    module's compiled traces (the flag is read at trace time, so a
+    stale cache would keep returning the narrow program).  Callers
+    must rebuild their own jitted closures (e.g. recreate the Booster)."""
+    global NARROW_ONEHOT
+    NARROW_ONEHOT = False
+    hist_multileaf_masked.clear_cache()
+
+
 def _coerce_dtype(input_dtype: str) -> str:
     """int8 means caller-side gradient quantization, which only the
     rounds learner's masked kernel implements; a bare int8 cast would

@@ -43,6 +43,14 @@ from .lookup import table_lookup, select_bin_by_feature
 FUSED_PARTITION = _os.environ.get("LGBT_FUSED_PARTITION", "1") != "0"
 
 
+def disable_fused_partition():
+    """Runtime fallback (see histogram.disable_narrow_onehot): flip the
+    flag and drop compiled traces; callers rebuild their jits."""
+    global FUSED_PARTITION
+    FUSED_PARTITION = False
+    _partition_pallas.clear_cache()
+
+
 def _partition_kernel(tbl_ref, gb_ref, lid_ref, out_ref, *, S: int,
                       bin_offset: int):
     """tbl_ref [8, S] int8 rows (f_hi, f_lo, thr-128, cat, nli-128, 0..);
