@@ -192,3 +192,20 @@ def test_int8_stored_bins_grow_identical_trees():
                                   np.asarray(arrs8.threshold_bin))
     np.testing.assert_allclose(np.asarray(arrs32.leaf_value),
                                np.asarray(arrs8.leaf_value), rtol=1e-6)
+
+
+def test_rounds_num_leaves_past_int8_gates():
+    """num_leaves > 255 exceeds both narrow int8 encodings (leaf-id mask
+    compare, fused partition slot table) — the gates must route to the
+    wide paths and grow a correct tree rather than alias mod-256."""
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(3)
+    X = rng.randn(4000, 6)
+    y = (X[:, 0] * X[:, 1] + 0.3 * X[:, 2] > 0).astype(float)
+    params = {"objective": "binary", "num_leaves": 300, "verbose": -1,
+              "min_data_in_leaf": 5, "tree_growth": "rounds"}
+    bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=3)
+    p = bst.predict(X)
+    acc = ((p > 0.5) == (y > 0.5)).mean()
+    assert acc > 0.9, acc
+    assert max(t.num_leaves for t in bst._gbdt.models) > 255
