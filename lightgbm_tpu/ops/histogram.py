@@ -119,6 +119,8 @@ def disable_narrow_onehot():
     global NARROW_ONEHOT
     NARROW_ONEHOT = False
     hist_multileaf_masked.clear_cache()
+    hist_pallas.clear_cache()
+    hist_pallas_multileaf.clear_cache()
 
 
 def _coerce_dtype(input_dtype: str) -> str:
@@ -160,9 +162,7 @@ def _hist_kernel(gb_ref, vals_ref, out_ref, *, B: int, input_dtype):
             else jax.lax.Precision.DEFAULT)
     G = gb_ref.shape[1]
     for g in range(G):
-        gb = gb_ref[0, g, :]                    # [Ck]
-        oh = (gb[:, None] == jax.lax.broadcasted_iota(
-            jnp.int32, (1, B), 1)).astype(input_dtype)   # [Ck, B]
+        oh = _simple_onehot(gb_ref[0, g, :], B, input_dtype)  # [Ck, B]
         out_ref[0, g, :, :] += jnp.dot(
             vals, oh, preferred_element_type=jnp.float32, precision=prec)
 
@@ -232,9 +232,7 @@ def _hist_kernel_ml(gb_ref, vals_ref, out_ref, *, B: int, input_dtype):
             else jax.lax.Precision.DEFAULT)
     G = gb_ref.shape[1]
     for g in range(G):
-        gb = gb_ref[0, g, :]
-        oh = (gb[:, None] == jax.lax.broadcasted_iota(
-            jnp.int32, (1, B), 1)).astype(input_dtype)
+        oh = _simple_onehot(gb_ref[0, g, :], B, input_dtype)
         out_ref[0, g, :, :] += jnp.dot(
             vals, oh, preferred_element_type=jnp.float32, precision=prec)
 
@@ -325,6 +323,17 @@ def hist_multileaf(gb_t: jax.Array, vals: jax.Array, *, num_bins_padded: int,
                                      input_dtype=input_dtype)
     return hist_multileaf_xla(gb_t, vals, num_bins_padded=num_bins_padded,
                               input_dtype=input_dtype)
+
+
+def _simple_onehot(gb, B, input_dtype):
+    """Unpacked one-hot for the gather-fed kernels: the compare runs in
+    bf16 when the output is bf16 (2x the int32 VPU lane volume; bins
+    <= 255 are bf16-exact — gated on B <= 256), else in int32."""
+    iota = jax.lax.broadcasted_iota(jnp.int32, (1, B), 1)
+    if input_dtype == jnp.bfloat16 and NARROW_ONEHOT and B <= 256:
+        return (gb.astype(jnp.bfloat16)[:, None]
+                == iota.astype(jnp.bfloat16)).astype(jnp.bfloat16)
+    return (gb[:, None] == iota).astype(input_dtype)
 
 
 def _packed_onehot(gb_ref, g_, B, pack, bins_sub, out_dtype,

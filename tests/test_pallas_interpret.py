@@ -305,3 +305,28 @@ def test_hist_masked_narrow_lid_aliasing():
     # leaf-254 slot counts exactly its rows (aliased pad rows add zero)
     assert np.asarray(h_n)[0, 0, 2].sum() == (lid == 254).sum()
     assert np.asarray(h_n)[1].max() == 0.0
+
+
+def test_hist_pallas_bf16_narrow_onehot():
+    """Gather-fed kernels with the bf16 narrow compare (_simple_onehot):
+    must match the XLA bf16 formulation."""
+    rng, gb = _rand(3001, 9, 255, seed=33)
+    vals8 = np.zeros((8, 3001), np.float32)
+    vals8[0] = rng.randn(3001)
+    vals8[1] = rng.rand(3001)
+    vals8[2] = 1.0
+    h_pl = hist_pallas(jnp.asarray(gb), jnp.asarray(vals8),
+                       num_bins_padded=256, input_dtype="bfloat16",
+                       interpret=True)
+    h_x = hist_xla(jnp.asarray(gb.T), jnp.asarray(vals8[:3]),
+                   num_bins_padded=256, input_dtype="bfloat16")
+    np.testing.assert_allclose(np.asarray(h_pl), np.asarray(h_x),
+                               rtol=2e-2, atol=2e-2)
+    m = rng.randn(16, 3001).astype(np.float32)
+    h_ml = hist_pallas_multileaf(jnp.asarray(gb), jnp.asarray(m),
+                                 num_bins_padded=256,
+                                 input_dtype="bfloat16", interpret=True)
+    h_mlx = hist_multileaf_xla(jnp.asarray(gb), jnp.asarray(m),
+                               num_bins_padded=256, input_dtype="bfloat16")
+    np.testing.assert_allclose(np.asarray(h_ml), np.asarray(h_mlx),
+                               rtol=2e-2, atol=2e-2)
