@@ -297,34 +297,45 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
 
         # early rounds have few splittable leaves (1, 2, 4, ... for a
         # balanced tree) but a fixed-K pass pays the full Mp=3K matmul
-        # M dimension for mostly-empty slots — a two-tier kernel cuts
-        # the first rounds' MXU work ~8x: when a chunk has <= K_SMALL
-        # active slots, histogram through the small-K kernel and
-        # zero-pad the result (inactive slots are dropped downstream
-        # anyway, so the padding rows are never read)
+        # M dimension for mostly-empty slots — tiered kernels cut the
+        # early rounds' MXU work: a chunk with <= 8 active slots runs
+        # the K=8 kernel (rounds 1-4 of a balanced tree), <= 32 the
+        # K=32 kernel (rounds 5-6; the matmul is ~62% of the pass once
+        # the compares are narrow, so Mp 256->96 matters), else full K.
+        # Results are zero-padded to Kc — inactive slots are dropped
+        # downstream, so the padding rows are never read.
         K_SMALL = min(8, K)
+        K_MID = min(32, K)
 
         def hist_tiered(slv, dk, Kc):
             full_call = functools.partial(
                 hist_multileaf_masked, num_bins_padded=B, backend=backend,
                 input_dtype=input_dtype, max_num_bin=max_num_bin,
                 num_leaves=L)
+
+            def at(Kt):
+                h = full_call(binsf, leaf_id2, gh8, slv[:Kt])
+                if Kt >= Kc:
+                    return h
+                return jnp.concatenate(
+                    [h, jnp.zeros((Kc - Kt,) + h.shape[1:], h.dtype)],
+                    axis=0)
+
             if Kc <= K_SMALL:
                 return full_call(binsf, leaf_id2, gh8, slv)
 
-            def small(_):
-                h = full_call(binsf, leaf_id2, gh8, slv[:K_SMALL])
-                return jnp.concatenate(
-                    [h, jnp.zeros((Kc - K_SMALL,) + h.shape[1:],
-                                  h.dtype)], axis=0)
+            def full_or_mid(_):
+                if Kc <= K_MID:
+                    return at(Kc)
+                # gate on the REAL precondition (no active slot past
+                # the window), not on the count — robust even if the
+                # sorted-prefix layout of `do` ever changes
+                return jax.lax.cond(~jnp.any(dk[K_MID:]),
+                                    lambda _: at(K_MID),
+                                    lambda _: at(Kc), None)
 
-            def full(_):
-                return full_call(binsf, leaf_id2, gh8, slv)
-
-            # gate on the REAL precondition (no active slot past the
-            # small window), not on the count — robust even if the
-            # sorted-prefix layout of `do` ever changes
-            return jax.lax.cond(~jnp.any(dk[K_SMALL:]), small, full, None)
+            return jax.lax.cond(~jnp.any(dk[K_SMALL:]),
+                                lambda _: at(K_SMALL), full_or_mid, None)
 
         leaf_best2 = leaf_best
         leaf_hist2 = leaf_hist
