@@ -94,3 +94,16 @@ def test_partition_fallback_gates():
     out_b = partition_rows(bins, lid, tbl, num_slots=32, backend="xla",
                            num_bins_padded=512)
     np.testing.assert_array_equal(np.asarray(out_a), np.asarray(out_b))
+
+
+def test_lookup_pallas_matches_scan():
+    """The fused pallas table_lookup (one-hot in VMEM) vs the XLA scan:
+    exact f32 equality, including out-of-range ids selecting nothing and
+    non-multiple-of-chunk N."""
+    from lightgbm_tpu.ops.lookup import _lookup_pallas, table_lookup
+    rng = np.random.RandomState(5)
+    tbl = jnp.asarray(rng.randn(3, 256).astype(np.float32))
+    ids = rng.randint(-1, 256, size=9001).astype(np.int32)
+    ref = table_lookup(tbl, jnp.asarray(ids), num_slots=256)
+    out = _lookup_pallas(tbl, jnp.asarray(ids), interpret=True)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
