@@ -68,15 +68,5 @@ predict.lgb.Booster <- function(object, data, raw_score = FALSE,
   if (ncol(res) == 1L) res[[1L]] else as.matrix(res)
 }
 
-lgb.importance <- function(booster) {
-  lines <- readLines(booster$model_file)
-  start <- grep("^feature importances:", lines)
-  if (length(start) == 0L) return(data.frame(Feature = character(),
-                                             Gain = integer()))
-  imp <- lines[(start + 1L):length(lines)]
-  imp <- imp[nzchar(imp)]
-  kv <- strsplit(imp, "=")
-  data.frame(Feature = vapply(kv, `[`, "", 1L),
-             SplitCount = as.integer(vapply(kv, `[`, "", 2L)),
-             stringsAsFactors = FALSE)
-}
+# lgb.importance lives in lgb.importance.R (Gain/Cover/Frequency over
+# the parsed tree table, reference R-package/R/lgb.importance.R parity).

@@ -1,0 +1,151 @@
+# lgb.cv — k-fold cross-validation with per-iteration metric
+# aggregation, mirroring the reference R package's API
+# (R-package/R/lgb.cv.R: record_evals with per-iteration eval/eval_err,
+# client-side early stopping, lgb.CVBooster result) over the CLI
+# contract: each fold trains through `task=train` with metric_freq=1 and
+# the per-iteration "Iteration:i, <set> <metric> : <value>" log lines
+# are parsed and aggregated across folds (mean + stdv).
+#
+# Early stopping is client-side (the reference's is too, via the
+# early_stopping callback): every fold runs the full nrounds, then the
+# aggregated means choose best_iter — the FIRST metric in eval order
+# whose no-improvement window hits early_stopping_rounds stops the
+# record at ITS best iteration (reference callback.R:189-202 semantics).
+
+.lgb.parse_evals <- function(log) {
+  # lines carry the logger prefix "[LightGBM-TPU] [Info] " — match
+  # the Iteration payload anywhere in the line
+  m <- regmatches(log, regexec(
+    "Iteration:([0-9]+), ([^ ]+) ([^ ]+) : ([-+0-9.eE]+)$", log))
+  m <- m[vapply(m, length, 1L) == 5L]
+  if (!length(m)) {
+    return(data.frame(iter = integer(0), set = character(0),
+                      metric = character(0), value = numeric(0),
+                      stringsAsFactors = FALSE))
+  }
+  data.frame(iter = as.integer(vapply(m, `[`, "", 2L)),
+             set = vapply(m, `[`, "", 3L),
+             metric = vapply(m, `[`, "", 4L),
+             value = as.numeric(vapply(m, `[`, "", 5L)),
+             stringsAsFactors = FALSE)
+}
+
+.lgb.metric_higher_better <- function(metric) {
+  any(vapply(c("auc", "ndcg", "map"), function(p) {
+    startsWith(metric, p)
+  }, TRUE))
+}
+
+.lgb.make_folds <- function(y, n, nfold, stratified) {
+  if (stratified && !is.null(y)) {
+    idx <- seq_len(n)
+    fold_of <- integer(n)
+    for (cls in unique(y)) {
+      members <- sample(idx[y == cls])
+      fold_of[members] <- rep_len(seq_len(nfold), length(members))
+    }
+  } else {
+    fold_of <- sample(rep_len(seq_len(nfold), n))
+  }
+  lapply(seq_len(nfold), function(k) which(fold_of == k))
+}
+
+lgb.cv <- function(params = list(), data, nrounds = 100L, nfold = 5L,
+                   folds = NULL, stratified = FALSE,
+                   early_stopping_rounds = NULL, showsd = TRUE,
+                   verbose = 1L) {
+  if (!inherits(data, "lgb.Dataset")) stop("data must be an lgb.Dataset")
+  x <- as.matrix(data$data)
+  y <- data$label
+  n <- nrow(x)
+  if (is.null(folds)) {
+    folds <- .lgb.make_folds(y, n, nfold, stratified)
+  }
+  params$metric_freq <- 1L   # per-iteration lines are the aggregation feed
+  # the CLI only emits eval lines at verbose >= 1 and those lines ARE the
+  # data feed — a user verbose=-1 must not starve the aggregation (R-side
+  # quieting is the separate `verbose` argument)
+  params$verbose <- 1L
+
+  per_fold <- list()         # fold -> data.frame(iter, metric, value)
+  boosters <- list()
+  for (k in seq_along(folds)) {
+    test_idx <- folds[[k]]
+    tr <- lgb.Dataset(x[-test_idx, , drop = FALSE], y[-test_idx],
+                      weight = if (!is.null(data$weight))
+                        data$weight[-test_idx],
+                      params = data$params)
+    te <- lgb.Dataset(x[test_idx, , drop = FALSE], y[test_idx],
+                      weight = if (!is.null(data$weight))
+                        data$weight[test_idx],
+                      params = data$params)
+    # CLI verbosity must stay >= 1: the eval lines ARE the data feed;
+    # R-side printing is governed separately by `verbose`
+    bst <- lgb.train(params, tr, nrounds, valids = list(test = te),
+                     verbose = 1L)
+    ev <- .lgb.parse_evals(bst$evals_log)
+    per_fold[[k]] <- ev[ev$set != "train", , drop = FALSE]
+    boosters[[k]] <- bst
+  }
+
+  metrics <- unique(per_fold[[1L]]$metric)
+  iters <- sort(unique(per_fold[[1L]]$iter))
+  record_evals <- list(valid = list())
+  for (mname in metrics) {
+    vals <- vapply(per_fold, function(ev) {
+      v <- ev$value[ev$metric == mname][order(ev$iter[ev$metric == mname])]
+      v[seq_along(iters)]
+    }, numeric(length(iters)))           # [iters, folds]
+    vals <- matrix(vals, nrow = length(iters))
+    record_evals$valid[[mname]] <- list(
+      eval = as.list(rowMeans(vals)),
+      eval_err = as.list(apply(vals, 1L, stats::sd)))
+  }
+
+  best_iter <- length(iters)
+  if (!is.null(early_stopping_rounds) && length(metrics)) {
+    best_score <- rep(-Inf, length(metrics))
+    best_it <- rep(0L, length(metrics))
+    stop_at <- NA_integer_
+    for (i in seq_along(iters)) {
+      for (mi in seq_along(metrics)) {
+        mean_i <- record_evals$valid[[metrics[mi]]]$eval[[i]]
+        score <- if (.lgb.metric_higher_better(metrics[mi])) mean_i
+                 else -mean_i
+        if (score > best_score[mi]) {
+          best_score[mi] <- score
+          best_it[mi] <- i
+        } else if (i - best_it[mi] >= early_stopping_rounds) {
+          stop_at <- best_it[mi]
+          break
+        }
+      }
+      if (!is.na(stop_at)) break
+    }
+    if (!is.na(stop_at)) {
+      best_iter <- stop_at
+      for (mname in metrics) {
+        record_evals$valid[[mname]]$eval <-
+          record_evals$valid[[mname]]$eval[seq_len(best_iter)]
+        record_evals$valid[[mname]]$eval_err <-
+          record_evals$valid[[mname]]$eval_err[seq_len(best_iter)]
+      }
+    }
+  }
+
+  if (verbose > 0L) {
+    for (mname in metrics) {
+      e <- record_evals$valid[[mname]]$eval
+      s <- record_evals$valid[[mname]]$eval_err
+      i <- length(e)
+      cat(sprintf("[%d] valid %s: %g%s\n", i, mname, e[[i]],
+                  if (showsd) sprintf(" + %g", s[[i]]) else ""))
+    }
+  }
+
+  structure(list(best_iter = best_iter,
+                 record_evals = record_evals,
+                 boosters = boosters,
+                 folds = folds),
+            class = "lgb.CVBooster")
+}

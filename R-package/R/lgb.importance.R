@@ -1,0 +1,34 @@
+# lgb.importance — per-feature Gain / Cover / Frequency, mirroring the
+# reference R package's API (R-package/R/lgb.importance.R: Gain = summed
+# split gain, Cover = summed internal_count over this feature's splits,
+# Frequency = split count; percentage=TRUE normalizes each column).
+# Aggregates over lgb.model.dt.tree instead of a C++ fast path.
+
+lgb.importance <- function(model, percentage = TRUE) {
+  if (!inherits(model, "lgb.Booster")) {
+    stop("'model' has to be an object of class lgb.Booster")
+  }
+  dt <- lgb.model.dt.tree(model)
+  splits <- dt[!is.na(dt$split_index), , drop = FALSE]
+  if (nrow(splits) == 0L) {
+    return(data.frame(Feature = character(0), Gain = numeric(0),
+                      Cover = numeric(0), Frequency = numeric(0),
+                      stringsAsFactors = FALSE))
+  }
+  gain <- tapply(splits$split_gain, splits$split_feature, sum)
+  cover <- tapply(splits$internal_count, splits$split_feature, sum)
+  freq <- tapply(rep(1L, nrow(splits)), splits$split_feature, sum)
+  imp <- data.frame(Feature = names(gain),
+                    Gain = as.numeric(gain),
+                    Cover = as.numeric(cover[names(gain)]),
+                    Frequency = as.numeric(freq[names(gain)]),
+                    stringsAsFactors = FALSE)
+  imp <- imp[order(imp$Gain, decreasing = TRUE), , drop = FALSE]
+  if (percentage) {
+    imp$Gain <- imp$Gain / sum(imp$Gain)
+    imp$Cover <- imp$Cover / sum(imp$Cover)
+    imp$Frequency <- imp$Frequency / sum(imp$Frequency)
+  }
+  rownames(imp) <- NULL
+  imp
+}

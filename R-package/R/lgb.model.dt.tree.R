@@ -1,0 +1,110 @@
+# lgb.model.dt.tree — parse a trained booster's model text into a flat
+# per-node table, mirroring the reference R package's API
+# (R-package/R/lgb.model.dt.tree.R) over the model-text contract
+# (the checkpoint format of src/io/gbdt_model_text.cpp / our tree.py).
+# Base-R implementation: returns a data.frame (the reference returns a
+# data.table; data.frame keeps this package dependency-free).
+
+# Parse the LightGBM model text into
+#   list(feature_names = chr[], trees = list(list(num_leaves=, vectors...)))
+.lgb.parse_model <- function(model_file) {
+  lines <- readLines(model_file)
+  fn_line <- grep("^feature_names=", lines, value = TRUE)
+  feature_names <- if (length(fn_line)) {
+    strsplit(sub("^feature_names=", "", fn_line[1L]), " ")[[1L]]
+  } else {
+    character(0)
+  }
+  starts <- grep("^Tree=", lines)
+  num_keys <- c("split_gain", "threshold", "leaf_value", "internal_value",
+                "shrinkage")
+  trees <- lapply(seq_along(starts), function(i) {
+    from <- starts[i]
+    to <- if (i < length(starts)) starts[i + 1L] - 1L else length(lines)
+    block <- lines[from:to]
+    # stop at the importances footer if this is the last tree
+    footer <- grep("^feature importances:", block)
+    if (length(footer)) block <- block[seq_len(footer[1L] - 1L)]
+    kv <- block[grepl("=", block, fixed = TRUE)]
+    keys <- sub("=.*$", "", kv)
+    vals <- sub("^[^=]*=", "", kv)
+    tree <- list(tree_index = i - 1L)
+    for (j in seq_along(keys)) {
+      k <- keys[j]
+      v <- strsplit(vals[j], " ")[[1L]]
+      tree[[k]] <- if (k %in% num_keys) as.numeric(v)
+                   else if (k %in% c("Tree", "num_leaves", "split_feature",
+                                     "decision_type", "left_child",
+                                     "right_child", "leaf_parent",
+                                     "leaf_count", "internal_count",
+                                     "has_categorical")) as.integer(v)
+                   else v
+    }
+    tree
+  })
+  list(feature_names = feature_names, trees = trees)
+}
+
+lgb.model.dt.tree <- function(model) {
+  if (!inherits(model, "lgb.Booster")) {
+    stop("'model' has to be an object of class lgb.Booster")
+  }
+  parsed <- .lgb.parse_model(model$model_file)
+  fnames <- parsed$feature_names
+
+  one_tree <- function(tree) {
+    nl <- tree$num_leaves
+    ns <- nl - 1L                      # internal node count
+    empty <- data.frame(
+      tree_index = integer(0), split_index = integer(0),
+      split_feature = character(0), node_parent = integer(0),
+      leaf_index = integer(0), leaf_parent = integer(0),
+      split_gain = numeric(0), threshold = numeric(0),
+      decision_type = integer(0), internal_value = numeric(0),
+      internal_count = integer(0), leaf_value = numeric(0),
+      leaf_count = integer(0), stringsAsFactors = FALSE)
+    if (is.null(nl) || nl < 1L) return(empty)
+    if (ns >= 1L) {
+      # parent of internal node j: the node whose child list holds +j
+      node_parent <- rep(NA_integer_, ns)
+      for (p in seq_len(ns)) {
+        for (child in c(tree$left_child[p], tree$right_child[p])) {
+          if (child >= 0L) node_parent[child + 1L] <- p - 1L
+        }
+      }
+      feat <- tree$split_feature + 1L
+      fname <- if (length(fnames)) fnames[feat] else as.character(feat - 1L)
+      internal <- data.frame(
+        tree_index = tree$tree_index, split_index = seq_len(ns) - 1L,
+        split_feature = fname, node_parent = node_parent,
+        leaf_index = NA_integer_, leaf_parent = NA_integer_,
+        split_gain = tree$split_gain[seq_len(ns)],
+        threshold = tree$threshold[seq_len(ns)],
+        decision_type = tree$decision_type[seq_len(ns)],
+        internal_value = tree$internal_value[seq_len(ns)],
+        internal_count = tree$internal_count[seq_len(ns)],
+        leaf_value = NA_real_, leaf_count = NA_integer_,
+        stringsAsFactors = FALSE)
+    } else {
+      internal <- empty
+    }
+    leaves <- data.frame(
+      tree_index = tree$tree_index, split_index = NA_integer_,
+      split_feature = NA_character_, node_parent = NA_integer_,
+      leaf_index = seq_len(nl) - 1L,
+      leaf_parent = if (!is.null(tree$leaf_parent)) tree$leaf_parent
+                    else rep(NA_integer_, nl),
+      split_gain = NA_real_, threshold = NA_real_,
+      decision_type = NA_integer_, internal_value = NA_real_,
+      internal_count = NA_integer_,
+      leaf_value = tree$leaf_value[seq_len(nl)],
+      leaf_count = if (!is.null(tree$leaf_count)) tree$leaf_count[seq_len(nl)]
+                   else rep(NA_integer_, nl),
+      stringsAsFactors = FALSE)
+    rbind(internal, leaves)
+  }
+
+  out <- do.call(rbind, lapply(parsed$trees, one_tree))
+  rownames(out) <- NULL
+  out
+}

@@ -1,0 +1,23 @@
+# lgb.plot.importance — horizontal importance bar chart, mirroring the
+# reference R package's API (R-package/R/lgb.plot.importance.R) with
+# base graphics (no ggplot dependency).
+
+lgb.plot.importance <- function(tree_imp, top_n = 10L,
+                                measure = "Gain",
+                                left_margin = 10L, cex = NULL) {
+  if (!is.data.frame(tree_imp) || !measure %in% colnames(tree_imp)) {
+    stop("tree_imp must be the output of lgb.importance; unknown ",
+         "measure '", measure, "'")
+  }
+  top_n <- min(top_n, nrow(tree_imp))
+  imp <- tree_imp[order(tree_imp[[measure]], decreasing = TRUE), ,
+                  drop = FALSE][seq_len(top_n), , drop = FALSE]
+  imp <- imp[rev(seq_len(nrow(imp))), , drop = FALSE]  # largest on top
+  op <- graphics::par(mar = c(4, left_margin, 2, 1))
+  on.exit(graphics::par(op))
+  graphics::barplot(imp[[measure]], names.arg = imp$Feature, horiz = TRUE,
+                    las = 1, cex.names = cex,
+                    xlab = measure,
+                    main = "Feature importance")
+  invisible(imp)
+}

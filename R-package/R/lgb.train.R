@@ -49,25 +49,4 @@ lgb.train <- function(params = list(), data, nrounds = 100L,
   .lgb.new_booster(model_file, evals_log = log)
 }
 
-lgb.cv <- function(params = list(), data, nrounds = 100L, nfold = 5L,
-                   verbose = 1L) {
-  if (!inherits(data, "lgb.Dataset")) stop("data must be an lgb.Dataset")
-  x <- as.matrix(data$data)
-  y <- data$label
-  n <- nrow(x)
-  folds <- sample(rep_len(seq_len(nfold), n))
-  scores <- vector("list", nfold)
-  for (k in seq_len(nfold)) {
-    tr <- lgb.Dataset(x[folds != k, , drop = FALSE], y[folds != k],
-                      params = data$params)
-    te <- lgb.Dataset(x[folds == k, , drop = FALSE], y[folds == k],
-                      params = data$params)
-    bst <- lgb.train(params, tr, nrounds,
-                     valids = list(test = te), verbose = verbose)
-    # last reported metric line for the fold's valid set
-    metric_lines <- grep(": *[-0-9.eE]+$", bst$evals_log, value = TRUE)
-    scores[[k]] <- utils::tail(metric_lines, 1L)
-  }
-  structure(list(folds = folds, fold_results = scores),
-            class = "lgb.cv_result")
-}
+# lgb.cv lives in lgb.cv.R (per-iteration aggregation + early stopping).

@@ -1,0 +1,89 @@
+# Model-introspection tools: lgb.model.dt.tree / lgb.importance /
+# lgb.plot.importance / lgb.cv records (mirroring the reference
+# testthat coverage of R-package/tests/).  Runs under testthat when an
+# R toolchain is available; the same contracts are exercised from
+# Python in tests/test_r_package.py.
+library(testthat)
+library(lightgbm.tpu)
+
+make_problem <- function(n = 600, f = 5, seed = 3) {
+  set.seed(seed)
+  x <- matrix(rnorm(n * f), n, f)
+  y <- as.numeric(x[, 1] + 0.5 * x[, 2] > 0)
+  list(x = x, y = y)
+}
+
+test_that("lgb.model.dt.tree parses every node of every tree", {
+  p <- make_problem()
+  bst <- lgb.train(list(objective = "binary", num_leaves = 7,
+                        verbose = -1), lgb.Dataset(p$x, label = p$y),
+                   nrounds = 5)
+  dt <- lgb.model.dt.tree(bst)
+  expect_s3_class(dt, "data.frame")
+  expect_equal(sort(unique(dt$tree_index)), 0:4)
+  splits <- dt[!is.na(dt$split_index), ]
+  leaves <- dt[!is.na(dt$leaf_index), ]
+  # a tree with L leaves has L-1 internal nodes
+  expect_equal(nrow(leaves), nrow(splits) + 5L)
+  expect_true(all(splits$split_gain >= 0))
+  expect_true(all(splits$internal_count > 0))
+  # root nodes have no parent, every other internal node has one
+  roots <- splits[splits$split_index == 0L, ]
+  expect_true(all(is.na(roots$node_parent)))
+  nonroot <- splits[splits$split_index != 0L, ]
+  expect_true(all(!is.na(nonroot$node_parent)))
+  # feature names resolved from the model header
+  expect_true(all(grepl("^Column_", splits$split_feature)))
+})
+
+test_that("lgb.importance aggregates Gain/Cover/Frequency", {
+  p <- make_problem()
+  bst <- lgb.train(list(objective = "binary", num_leaves = 7,
+                        verbose = -1), lgb.Dataset(p$x, label = p$y),
+                   nrounds = 10)
+  imp <- lgb.importance(bst, percentage = TRUE)
+  expect_named(imp, c("Feature", "Gain", "Cover", "Frequency"))
+  expect_equal(sum(imp$Gain), 1, tolerance = 1e-9)
+  expect_equal(sum(imp$Frequency), 1, tolerance = 1e-9)
+  # the two signal features dominate
+  expect_true(imp$Feature[1L] %in% c("Column_0", "Column_1"))
+  # sorted by Gain descending
+  expect_true(all(diff(imp$Gain) <= 0))
+  imp_abs <- lgb.importance(bst, percentage = FALSE)
+  expect_true(all(imp_abs$Gain >= imp$Gain))
+})
+
+test_that("lgb.plot.importance draws and returns the top rows", {
+  p <- make_problem()
+  bst <- lgb.train(list(objective = "binary", num_leaves = 7,
+                        verbose = -1), lgb.Dataset(p$x, label = p$y),
+                   nrounds = 5)
+  imp <- lgb.importance(bst)
+  pdf(NULL)
+  top <- lgb.plot.importance(imp, top_n = 3)
+  dev.off()
+  expect_equal(nrow(top), min(3L, nrow(imp)))
+})
+
+test_that("lgb.cv aggregates per-iteration records and early-stops", {
+  p <- make_problem(n = 900)
+  cv <- lgb.cv(list(objective = "binary", metric = "binary_logloss",
+                    num_leaves = 7, verbose = -1),
+               lgb.Dataset(p$x, label = p$y), nrounds = 8L, nfold = 3L,
+               verbose = 0L)
+  expect_s3_class(cv, "lgb.CVBooster")
+  rec <- cv$record_evals$valid$binary_logloss
+  expect_equal(length(rec$eval), 8L)
+  expect_equal(length(rec$eval_err), 8L)
+  expect_true(rec$eval[[8L]] < rec$eval[[1L]])   # learning happened
+  expect_true(all(unlist(rec$eval_err) >= 0))
+  expect_equal(length(cv$boosters), 3L)
+  # early stopping truncates the record at best_iter
+  cv2 <- lgb.cv(list(objective = "binary", metric = "binary_logloss",
+                     num_leaves = 7, verbose = -1),
+                lgb.Dataset(p$x, label = p$y), nrounds = 30L, nfold = 3L,
+                early_stopping_rounds = 3L, verbose = 0L)
+  rec2 <- cv2$record_evals$valid$binary_logloss
+  expect_equal(length(rec2$eval), cv2$best_iter)
+  expect_true(cv2$best_iter <= 30L)
+})

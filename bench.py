@@ -57,6 +57,9 @@ LEAVES = int(os.environ.get("BENCH_LEAVES", 255))
 # faster than bfloat16 (k_sweep_measured.json).  bfloat16 remains the
 # validated fallback (tests/test_bf16.py).
 HIST_DTYPE = os.environ.get("BENCH_HIST_DTYPE", "int8")
+# 255 is the tracked north-star config; 63 is the reference accelerator
+# sweet spot (docs/GPU-Performance.md:153-156) measured as a variant
+BINS = int(os.environ.get("BENCH_BINS", 255))
 
 
 def synth_higgs(n, f=28, seed=42):
@@ -88,7 +91,7 @@ def main():
     X, y = synth_higgs(ROWS)
     params = {
         "objective": "binary", "metric": "auc", "verbose": -1,
-        "num_leaves": LEAVES, "learning_rate": 0.1, "max_bin": 255,
+        "num_leaves": LEAVES, "learning_rate": 0.1, "max_bin": BINS,
         "min_data_in_leaf": 1, "min_sum_hessian_in_leaf": 100.0,
         # bf16 histogram operands: validated at AUC parity with f32 on
         # this workload (the reference GPU path makes the same
@@ -97,6 +100,7 @@ def main():
     }
     train = lgb.Dataset(X, y)
     bst = lgb.Booster(params, train)
+    narrow_fallback = False
     try:
         bst.update()                 # first update = pallas compile
     except Exception:
@@ -111,6 +115,7 @@ def main():
               file=sys.stderr)
         disable_narrow_onehot()
         disable_fused_partition()
+        narrow_fallback = True
         bst = lgb.Booster(params, train)
         bst.update()
     for _ in range(WARMUP - 1):      # compile + cache warm
@@ -133,11 +138,14 @@ def main():
     # provenance.  Steady-state s/iter is the fair comparison: this bench
     # window is also post-compile steady state.
     tracked = os.path.join(root, "baseline_measured.json")
-    if ROWS == 10_500_000 and LEAVES == 255 and os.path.exists(tracked):
+    if (ROWS == 10_500_000 and LEAVES == 255 and BINS == 255
+            and os.path.exists(tracked)):
         ref = json.load(open(tracked)).get("measured", {})
         if ref.get("ref_seconds_per_iter_steady_state"):
             vs = ref["ref_seconds_per_iter_steady_state"] / s_per_iter
-    if vs == 0.0:
+    if vs == 0.0 and BINS == 255:
+        # the ad-hoc baseline is a 255-bin run (make_baseline.py); a
+        # 63-bin variant must not claim a speedup against it
         base_file = os.path.join(root, ".bench", "baseline.json")
         if os.path.exists(base_file):
             with open(base_file) as f:
@@ -145,12 +153,29 @@ def main():
             if base.get("rows") == ROWS and base.get("num_leaves") == LEAVES:
                 vs = base["seconds_per_iter"] / s_per_iter
 
+    # record the kernel configuration that ACTUALLY ran, so A/B artifacts
+    # can't mislabel a fallback path as the measured configuration
+    from lightgbm_tpu.ops import histogram as _h
+    from lightgbm_tpu.ops import partition as _p
+    from lightgbm_tpu.learner.common import padded_bin_count as _padded_bin_count
     out = {
         "metric": f"synthetic-higgs {ROWS}x28 gbdt {LEAVES} leaves, "
-                  "255 bins: train seconds/iter",
+                  f"{BINS} bins: train seconds/iter",
         "value": round(s_per_iter, 4),
         "unit": "s/iter",
         "vs_baseline": round(vs, 4),
+        "kernel_flags": {
+            "narrow_onehot": bool(_h.NARROW_ONEHOT),
+            "fused_partition": bool(_p.FUSED_PARTITION),
+            # effective gather-kernel chunk (post VMEM self-cap), not
+            # just the env-derived global — the artifact must show what ran
+            "hist_chunk": _h.effective_gather_chunk(
+                _padded_bin_count(BINS + 1), HIST_DTYPE),
+            "hist_chunk_env": int(_h.HIST_CHUNK),
+            "masked_hist_chunk": int(_h.MASKED_HIST_CHUNK),
+            "hist_dtype": HIST_DTYPE,
+            "narrow_compile_fallback": narrow_fallback,
+        },
     }
     if note:
         out["note"] = note

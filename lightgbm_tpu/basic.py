@@ -455,9 +455,10 @@ class Booster:
         return self._gbdt.to_json()
 
     def feature_importance(self, importance_type: str = "split") -> np.ndarray:
-        imp = self._gbdt.feature_importance()
+        imp = self._gbdt.feature_importance(importance_type)
         names = self.feature_name()
-        return np.array([imp.get(n, 0) for n in names], np.int64)
+        dt = np.float64 if importance_type == "gain" else np.int64
+        return np.array([imp.get(n, 0) for n in names], dt)
 
     def feature_name(self) -> List[str]:
         return list(self._gbdt.feature_names)

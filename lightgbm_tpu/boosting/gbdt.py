@@ -488,17 +488,26 @@ class GBDT:
         return n
 
     # ------------------------------------------------------------------
-    def feature_importance(self) -> Dict[str, int]:
+    def feature_importance(self, importance_type: str = "split"
+                           ) -> Dict[str, float]:
+        """Per-feature importance (gbdt.cpp:850-872 split counts; "gain"
+        sums split_gain per feature, the reference C API's
+        importance_type=1)."""
         self._flush_pending()
-        """Split-count importance (gbdt.cpp:850-872)."""
-        cnt = np.zeros(self.max_feature_idx + 1, np.int64)
+        cnt = np.zeros(self.max_feature_idx + 1, np.float64)
         for t in self.models:
             for i in range(t.num_leaves - 1):
-                cnt[t.split_feature[i]] += 1
-        pairs = [(int(c), self.feature_names[i] if i < len(self.feature_names)
-                  else f"Column_{i}") for i, c in enumerate(cnt) if c > 0]
+                if importance_type == "gain":
+                    cnt[t.split_feature[i]] += float(t.split_gain[i])
+                else:
+                    cnt[t.split_feature[i]] += 1
+        pairs = [(float(c), self.feature_names[i]
+                  if i < len(self.feature_names) else f"Column_{i}")
+                 for i, c in enumerate(cnt) if c > 0]
         pairs.sort(key=lambda p: -p[0])
-        return {name: c for c, name in pairs}
+        if importance_type == "gain":
+            return {name: c for c, name in pairs}
+        return {name: int(c) for c, name in pairs}
 
     def sub_model_name(self) -> str:
         return "tree"
@@ -580,6 +589,12 @@ class GBDT:
         self.iter_ = 0
 
     def to_json(self) -> Dict:
+        """Field-for-field parity with the reference's DumpModel
+        (gbdt.cpp:658-692): name, num_class, num_tree_per_iteration,
+        label_index, max_feature_idx, feature_names, tree_info with a
+        tree_index per entry; per-tree fields from Tree::ToJSON
+        (tree.cpp:326-365).  `objective` is an extension (the reference
+        omits it from the dump but needs it to reload)."""
         self._flush_pending()
         return {
             "name": self.sub_model_name(),
@@ -589,7 +604,8 @@ class GBDT:
             "max_feature_idx": self.max_feature_idx,
             "objective": self.objective.to_string() if self.objective else "",
             "feature_names": self.feature_names,
-            "tree_info": [t.to_json() for t in self.models],
+            "tree_info": [dict(tree_index=i, **t.to_json())
+                          for i, t in enumerate(self.models)],
         }
 
 

@@ -181,8 +181,8 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 10,
         boosters.append(bst)
 
     results = collections.defaultdict(list)
-    best_iter = num_boost_round
-    history = collections.defaultdict(list)
+    best_score: Dict[str, float] = {}
+    best_it: Dict[str, int] = {}
     for i in range(num_boost_round):
         agg = collections.defaultdict(list)
         for bst in boosters:
@@ -200,16 +200,21 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 10,
                             for n in set(k[0] for k in agg))
             print(f"[{i + 1}]\t{msg}")
         if early_stopping_rounds:
+            # Reference semantics (engine.py:414-418 + callback.py:189-202):
+            # per-metric best tracking; the FIRST metric in eval order whose
+            # no-improvement window hits the limit stops the run, and every
+            # history is truncated at THAT metric's best iteration.
+            stop_at = None
             for (name, hib), mean in line.items():
-                history[name].append(mean if hib else -mean)
-            stop = False
-            for name, h in history.items():
-                bi = int(np.argmax(h))
-                if len(h) - 1 - bi >= early_stopping_rounds:
-                    best_iter = bi + 1
-                    stop = True
-            if stop:
+                score = mean if hib else -mean
+                if name not in best_score or score > best_score[name]:
+                    best_score[name] = score
+                    best_it[name] = i
+                elif i - best_it[name] >= early_stopping_rounds:
+                    stop_at = best_it[name] + 1
+                    break
+            if stop_at is not None:
                 for key in results:
-                    del results[key][best_iter:]
+                    del results[key][stop_at:]
                 break
     return dict(results)

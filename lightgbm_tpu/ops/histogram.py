@@ -105,6 +105,26 @@ def _hist_chunk_from_env(default: int) -> int:
 HIST_CHUNK = _hist_chunk_from_env(2048)
 MASKED_HIST_CHUNK = _hist_chunk_from_env(8192)
 
+
+def effective_gather_chunk(num_bins_padded: int,
+                           input_dtype: str = "float32") -> int:
+    """The row-chunk the gather-fed kernels ACTUALLY run (env global +
+    VMEM self-cap) — for artifacts that must record the real
+    configuration, not the env-derived request."""
+    if input_dtype == "int8":
+        input_dtype = "float32"   # gather kernels coerce (_coerce_dtype)
+    isz = jnp.dtype(input_dtype).itemsize
+    return min(HIST_CHUNK, _gather_chunk_cap(num_bins_padded, isz))
+
+
+def _gather_chunk_cap(B: int, itemsize: int = 4) -> int:
+    """VMEM self-cap for the gather-fed kernels' one-hot transient
+    ([Ck, B] in the compute dtype): LGBT_HIST_CHUNK drives both chunk
+    globals, so a masked-kernel sweep value (e.g. 16384) must not hand
+    these kernels a ~16 MB f32 transient.  Budget 4 MB, 128-aligned."""
+    cap = int(4e6) // (itemsize * max(B, 1))
+    return max(512, (cap // 128) * 128)
+
 # Narrow-dtype one-hot compare in the masked kernels (int8/bf16 instead
 # of int32 — see _packed_onehot).  Kill-switch for on-chip A/B.
 NARROW_ONEHOT = _os.environ.get("LGBT_NARROW_ONEHOT", "1") != "0"
@@ -182,7 +202,7 @@ def hist_pallas(gb_t: jax.Array, vals8: jax.Array, *, num_bins_padded: int,
     F, C = gb_t.shape
     B = num_bins_padded
     G = FEATURE_GROUP
-    Ck = min(C, HIST_CHUNK)
+    Ck = min(C, HIST_CHUNK, _gather_chunk_cap(B, jnp.dtype(input_dtype).itemsize))
     if C % Ck:
         # pad rows to a chunk multiple; padded slots have zero vals so they
         # contribute nothing to any bin
@@ -252,7 +272,7 @@ def hist_pallas_multileaf(gb_t: jax.Array, vals: jax.Array, *,
     M = vals.shape[0]
     B = num_bins_padded
     G = FEATURE_GROUP
-    Ck = min(C, HIST_CHUNK)
+    Ck = min(C, HIST_CHUNK, _gather_chunk_cap(B, jnp.dtype(input_dtype).itemsize))
     if C % Ck:
         pad = Ck - C % Ck
         gb_t = jnp.pad(gb_t, ((0, 0), (0, pad)))

@@ -157,14 +157,18 @@ def partition_rows(bins_fn: jax.Array, leaf_id: jax.Array,
         return jnp.where((nli > 0) & ~gl, nli, leaf_id)
 
     S = 256 if num_slots > 128 else 128          # lane-pad the slot axis
-    feat = tbl[0].astype(jnp.int32)
-    thr = tbl[1].astype(jnp.int32)
-    cat = tbl[2].astype(jnp.int32)
-    nli = tbl[3].astype(jnp.int32)
-    rows = jnp.stack([feat // 128, feat % 128, thr - 128, cat, nli - 128,
-                      jnp.zeros_like(feat), jnp.zeros_like(feat),
-                      jnp.zeros_like(feat)])
-    tbl8 = jnp.pad(rows, ((0, 0), (0, S - num_slots))).astype(jnp.int8)
+    # pad the slot axis BEFORE the -128 shifts: padded slots must decode
+    # to thr=0/nli=0 ("stay"), matching the XLA path's zero table rows —
+    # padding the shifted rows with 0 would decode to thr=128/nli=128 and
+    # silently MOVE any out-of-contract leaf id to leaf 128
+    pad = ((0, S - num_slots),)
+    feat = jnp.pad(tbl[0].astype(jnp.int32), pad)
+    thr = jnp.pad(tbl[1].astype(jnp.int32), pad)
+    cat = jnp.pad(tbl[2].astype(jnp.int32), pad)
+    nli = jnp.pad(tbl[3].astype(jnp.int32), pad)
+    zeros = jnp.zeros_like(feat)
+    tbl8 = jnp.stack([feat // 128, feat % 128, thr - 128, cat, nli - 128,
+                      zeros, zeros, zeros]).astype(jnp.int8)
     N = leaf_id.shape[0]
     return _partition_pallas(tbl8, bins_fn, leaf_id, num_slots=S,
                              interpret=interpret)[:N]

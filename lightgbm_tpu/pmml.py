@@ -21,7 +21,7 @@ def _tree_nodes(node: dict, feature_names: List[str], lines: List[str],
         return
     feat = quoteattr(feature_names[node["split_feature"]])
     thr = f'{node["threshold"]:.17g}'
-    cat = node.get("decision_type") == "=="
+    cat = node.get("decision_type") == "is"   # reference JSON type name
     op_l = "equal" if cat else "lessOrEqual"
     op_r = "notEqual" if cat else "greaterThan"
     lines.append(f'{pad}<Node id="split{node["split_index"]}" '

@@ -302,7 +302,10 @@ class Tree:
                     "split_feature": int(self.split_feature[index]),
                     "split_gain": float(self.split_gain[index]),
                     "threshold": float(self.threshold[index]),
-                    "decision_type": "==" if self.decision_type[index] == 1 else "<=",
+                    # reference names (tree.h GetDecisionTypeName):
+                    # numerical "no_greater", categorical "is"
+                    "decision_type": ("is" if self.decision_type[index] == 1
+                                      else "no_greater"),
                     "internal_value": float(self.internal_value[index]),
                     "internal_count": int(self.internal_count[index]),
                     "left_child": node_json(int(self.left_child[index])),

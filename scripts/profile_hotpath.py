@@ -80,9 +80,11 @@ def main():
     Mp = 8 * ((3 * K + 7) // 8)
     macs = float(N) * F * Mp * B  # one-hot contraction MACs per pass
     for dt in ("int8", "bfloat16", "float32"):
+        # max_num_bin=MB engages the same feature-packing layout the
+        # learner uses (2 features/lane-block at <=64 bins)
         t = timeit(lambda dt=dt: hist_multileaf_masked(
             bins, lid, gh8, sl, num_bins_padded=B, backend=backend,
-            input_dtype=dt, num_leaves=255))
+            input_dtype=dt, num_leaves=255, max_num_bin=MB))
         util = 2 * macs / t / PEAK[dt]
         rec["kernels"][f"hist_multileaf_masked_K{K}_{dt}"] = {
             "ms": round(t * 1e3, 2),
@@ -95,7 +97,7 @@ def main():
     t1 = timeit(lambda: hist_multileaf_masked(
         bins, lid, gh8, jnp.asarray(np.arange(1, dtype=np.int32)),
         num_bins_padded=B, backend=backend, input_dtype="int8",
-        num_leaves=255))
+        num_leaves=255, max_num_bin=MB))
     rec["kernels"]["hist_multileaf_masked_K1_root"] = {
         "ms": round(t1 * 1e3, 2)}
     print(f"hist_multileaf_masked K=1 (root): {t1*1e3:.1f} ms")
@@ -144,9 +146,15 @@ def main():
     rec["full_update_ms"] = round(full * 1e3, 1)
     print(f"full update(): {full*1e3:.1f} ms/iter")
 
-    with open(os.path.join(ROOT, "profile_hotpath_measured.json"),
-              "w") as f:
+    # non-default shapes get their own artifact: the north-star MFU
+    # profile (10.5M x 28 x 255) must not be clobbered by e.g. the
+    # Epsilon-shape decomposition run
+    at_default = (N == 10_500_000 and F == 28 and MB == 255)
+    name = ("profile_hotpath_measured.json" if at_default
+            else f"profile_{N}x{F}b{MB}_measured.json")
+    with open(os.path.join(ROOT, name), "w") as f:
         json.dump(rec, f, indent=1)
+    print(f"wrote {name}")
 
 
 if __name__ == "__main__":

@@ -1,9 +1,10 @@
 #!/bin/bash
-# Round-4 chip measurement queue.  Run when the TPU tunnel is alive;
+# Round-5 chip measurement queue.  Run when the TPU tunnel is alive;
 # each stage writes its own artifact and a stage marker, so a mid-queue
 # tunnel wedge loses only the running stage (rerun resumes after the
-# last marker).  Order = VERDICT priority: validate the new kernels
-# first, then the never-measured at-scale configs, then refreshes.
+# last marker).  Order = round-4 VERDICT priority: validate the round-4
+# kernels first, then the 63-bin variant, then the never-measured
+# at-scale configs, then the slow full refreshes.
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 MARK=.bench/chip_queue_done
@@ -21,16 +22,20 @@ stage() {  # stage <name> <cmd...>  (stdout tees to .bench/<name>.log)
 
 # 1. kernel-level profile at HEAD (narrow one-hot in)
 stage profile python scripts/profile_hotpath.py || exit 1
-# 2. short full-shape A/B: new kernels on (default) vs each off
+# 2. short full-shape A/B: round-4 kernels on (default) vs each off
 stage bench_narrow_on  env BENCH_ITERS=12 python bench.py || exit 1
 stage bench_narrow_off env LGBT_NARROW_ONEHOT=0 BENCH_ITERS=12 python bench.py || exit 1
 stage bench_part_off   env LGBT_FUSED_PARTITION=0 BENCH_ITERS=12 python bench.py || exit 1
 stage bench_chunk16k   env LGBT_HIST_CHUNK=16384 BENCH_ITERS=12 python bench.py || exit 1
-# 3. never-measured at-scale configs (VERDICT missing #2)
+# 3. the 63-bin variant (VERDICT #2: reference accelerator sweet spot)
+stage bench_63bin      env BENCH_BINS=63 BENCH_ITERS=12 python bench.py || exit 1
+# 4. never-measured at-scale configs (VERDICT #3)
 stage ltr  python scripts/run_ltr_scale.py || exit 1
 stage expo python scripts/run_expo_scale.py || exit 1
-# 4. wide-feature sweep rerun (63-bin packing + narrow kernels)
+# 5. wide-feature decomposition + sweep rerun (VERDICT #4)
+stage eps_profile python scripts/profile_hotpath.py 400000 2000 63 || exit 1
 stage shapes python scripts/run_shape_sweep.py || exit 1
-# 5. full 500-iter north-star refresh at HEAD (slowest last)
+# 6. full 500-iter north-star refreshes at HEAD (slowest last)
 stage northstar python scripts/run_northstar.py || exit 1
+stage northstar63 env NS_BINS=63 python scripts/run_northstar.py || exit 1
 echo "ALL STAGES DONE $(date +%H:%M:%S)"

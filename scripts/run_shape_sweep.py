@@ -85,6 +85,9 @@ def run_case(name, X, y, max_bin):
 
 
 def main():
+    from bench import default_backend_alive, force_cpu_backend
+    if os.environ.get("JAX_PLATFORMS") == "cpu" or not default_backend_alive():
+        force_cpu_backend()      # wedged remote-TPU tunnel or explicit CPU
     results = []
     n_eps = int(400_000 * SCALE)
     n_bos = int(1_000_000 * SCALE)
@@ -98,8 +101,10 @@ def main():
         r = run_case("bosch-shaped", Xb, yb, mb)
         r["density"] = round(nnz, 3)
         results.append(r)
+    import jax
     with open(os.path.join(ROOT, "shape_sweep_measured.json"), "w") as f:
         json.dump({"scale": SCALE, "iters": ITERS,
+                   "backend": jax.default_backend(),
                    "results": results}, f, indent=1)
     print("wrote shape_sweep_measured.json")
 

@@ -1,0 +1,20 @@
+#!/bin/bash
+# Tunnel watcher: probe the TPU backend in a subprocess every 5 minutes;
+# the moment it answers, drain the chip queue.  Keeps watching after a
+# mid-queue failure (the queue's stage markers make reruns cheap).
+# Log: .bench/tpu_watch.log
+cd "$(dirname "$0")/.."
+mkdir -p .bench
+while true; do
+  if timeout 150 python -c "import jax; assert jax.default_backend() == 'tpu', jax.default_backend(); print(jax.devices())" >> .bench/tpu_watch.log 2>&1; then
+    echo "$(date +%H:%M:%S) tunnel ALIVE - draining chip queue" | tee -a .bench/tpu_watch.log
+    if bash scripts/run_chip_queue.sh >> .bench/tpu_watch.log 2>&1; then
+      echo "$(date +%H:%M:%S) chip queue COMPLETE" | tee -a .bench/tpu_watch.log
+      exit 0
+    fi
+    echo "$(date +%H:%M:%S) queue failed mid-run; resuming watch" | tee -a .bench/tpu_watch.log
+  else
+    echo "$(date +%H:%M:%S) tunnel dead" >> .bench/tpu_watch.log
+  fi
+  sleep 240
+done

@@ -102,3 +102,35 @@ def test_parameters_doc_in_sync(tmp_path):
     tracked = open(os.path.join(root, "docs", "Parameters.md")).read()
     assert fresh == tracked, \
         "docs/Parameters.md is stale; run scripts/gen_parameters_doc.py"
+
+
+def test_dump_model_field_parity(model):
+    """dump_model JSON matches the reference's DumpModel field-for-field
+    (gbdt.cpp:658-692 top level; tree.cpp:326-365 per tree/node)."""
+    d = model.dump_model()
+    for k in ("name", "num_class", "num_tree_per_iteration", "label_index",
+              "max_feature_idx", "feature_names", "tree_info"):
+        assert k in d, k
+    assert d["name"] == "tree"
+    assert len(d["tree_info"]) == 3
+
+    def walk(node, depth=0):
+        if "leaf_index" in node:
+            assert set(node) == {"leaf_index", "leaf_parent", "leaf_value",
+                                 "leaf_count"}, set(node)
+            return
+        assert set(node) == {"split_index", "split_feature", "split_gain",
+                             "threshold", "decision_type", "internal_value",
+                             "internal_count", "left_child",
+                             "right_child"}, set(node)
+        # reference decision-type names (tree.h GetDecisionTypeName)
+        assert node["decision_type"] in ("no_greater", "is")
+        walk(node["left_child"], depth + 1)
+        walk(node["right_child"], depth + 1)
+
+    for i, ti in enumerate(d["tree_info"]):
+        assert ti["tree_index"] == i
+        for k in ("num_leaves", "shrinkage", "has_categorical",
+                  "tree_structure"):
+            assert k in ti, k
+        walk(ti["tree_structure"])

@@ -63,14 +63,14 @@ def test_bf16_end_to_end_auc_parity():
         os.path.abspath(__file__))))
     from bench import synth_higgs
 
-    X, y = synth_higgs(25_000, seed=11)
-    Xt, yt = synth_higgs(10_000, seed=12)
+    X, y = synth_higgs(12_000, seed=11)
+    Xt, yt = synth_higgs(8_000, seed=12)
     aucs = {}
     for dt in ("float32", "bfloat16"):
         evals = {}
         lgb.train({"objective": "binary", "metric": "auc", "num_leaves": 31,
                    "histogram_dtype": dt, "verbose": -1},
-                  lgb.Dataset(X, y), num_boost_round=8,
+                  lgb.Dataset(X, y), num_boost_round=6,
                   valid_sets=[lgb.Dataset(Xt, yt)], valid_names=["t"],
                   evals_result=evals, verbose_eval=False)
         aucs[dt] = evals["t"]["auc"][-1]

@@ -41,6 +41,7 @@ def test_cli_error_paths(tmp_path):
     assert main(["task=banana", "data=x"]) == 1
 
 
+@pytest.mark.slow
 def test_cli_continue_training(tmp_path, regression_example):
     """Regression: input_model must actually load and replay the model
     (create_boosting used to only sniff the first line for the type)."""

@@ -186,13 +186,17 @@ _TRAIN_WORKER = textwrap.dedent("""
 """)
 
 
+@pytest.mark.slow
 def test_two_process_training_equals_single_process(tmp_path):
     """End-to-end multi-host training (round-3 verdict ask #8): 2
     processes x 4 virtual devices train `tree_learner=data` over the
     8-device world on pre-partitioned blocks; BOTH ranks must produce
     the model an 8-device single-process run produces on the full file
     (reference analog: data_parallel_tree_learner.cpp:118-248 grows
-    identical trees on every machine)."""
+    identical trees on every machine).  Slow tier (40 s: three jax
+    subprocesses); the default tier keeps the 2-process collective world
+    and pre-partition loader tests, and the multichip driver gate
+    asserts sharded-vs-unsharded model equality every round."""
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     rng = np.random.RandomState(9)
     X = rng.randn(4000, 5)

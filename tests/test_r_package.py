@@ -58,7 +58,7 @@ def test_r_cli_keys_are_valid_config(r_cli_keys):
 def test_r_train_predict_contract(tmp_path):
     """Replays exactly what lgb.train + predict.lgb.Booster shell out."""
     rng = np.random.RandomState(0)
-    n = 800
+    n = 500
     x = rng.randn(n, 4)
     y = (x[:, 0] > 0).astype(float)
     train = tmp_path / "lgbtpu_train_1.tsv"
@@ -69,7 +69,7 @@ def test_r_train_predict_contract(tmp_path):
     conf = tmp_path / "lgbtpu_conf_1.conf"
     conf.write_text("\n".join([
         "objective = binary", "num_leaves = 15", "verbose = -1",
-        "task = train", f"data = {train}", "num_trees = 10",
+        "task = train", f"data = {train}", "num_trees = 8",
         f"output_model = {model}"]))
     r = _cli([f"config={conf}"], str(tmp_path))
     assert r.returncode == 0, r.stderr
@@ -89,15 +89,135 @@ def test_r_train_predict_contract(tmp_path):
     acc = ((preds > 0.5) == (y > 0.5)).mean()
     assert acc > 0.8, acc
 
-    # raw-score flag the R code appends
-    out_raw = tmp_path / "lgbtpu_out_raw.txt"
-    r = _cli(["task=predict", f"data={pred_in}", f"input_model={model}",
-              f"output_result={out_raw}", "predict_raw_score=true"],
-             str(tmp_path))
-    assert r.returncode == 0, r.stderr
-    raw = np.loadtxt(out_raw)
-    np.testing.assert_allclose(1 / (1 + np.exp(-raw)), preds, atol=1e-6)
-
     # importance block exists in the model text (lgb.importance parses it)
     txt = model.read_text()
     assert "feature importances:" in txt
+
+
+@pytest.mark.slow
+def test_r_raw_score_predict_contract(tmp_path):
+    """The predict_raw_score=true flag the R code appends (slow tier:
+    one extra jax subprocess; the default tier proves train+predict)."""
+    rng = np.random.RandomState(0)
+    n = 300
+    x = rng.randn(n, 4)
+    y = (x[:, 0] > 0).astype(float)
+    train = tmp_path / "t.tsv"
+    np.savetxt(train, np.column_stack([y, x]), delimiter="\t")
+    model = tmp_path / "m.txt"
+    conf = tmp_path / "c.conf"
+    conf.write_text("\n".join([
+        "objective = binary", "num_leaves = 15", "verbose = -1",
+        "task = train", f"data = {train}", "num_trees = 5",
+        f"output_model = {model}"]))
+    assert _cli([f"config={conf}"], str(tmp_path)).returncode == 0
+    pred_in = tmp_path / "p.tsv"
+    np.savetxt(pred_in, np.column_stack([np.zeros(n), x]), delimiter="\t")
+    out = tmp_path / "o.txt"
+    out_raw = tmp_path / "oraw.txt"
+    assert _cli(["task=predict", f"data={pred_in}", f"input_model={model}",
+                 f"output_result={out}"], str(tmp_path)).returncode == 0
+    assert _cli(["task=predict", f"data={pred_in}", f"input_model={model}",
+                 f"output_result={out_raw}", "predict_raw_score=true"],
+                str(tmp_path)).returncode == 0
+    raw = np.loadtxt(out_raw)
+    preds = np.loadtxt(out)
+    np.testing.assert_allclose(1 / (1 + np.exp(-raw)), preds, atol=1e-6)
+
+
+def _r_parse_model(text):
+    """Test-only replica of the R package's model-text parse
+    (R-package/R/lgb.model.dt.tree.R): per-tree vectors keyed by name."""
+    feature_names = []
+    for line in text.splitlines():
+        if line.startswith("feature_names="):
+            feature_names = line.split("=", 1)[1].split(" ")
+            break
+    trees = []
+    cur = None
+    for line in text.splitlines():
+        if line.startswith("Tree="):
+            cur = {}
+            trees.append(cur)
+        elif line.startswith("feature importances:"):
+            cur = None
+        elif cur is not None and "=" in line:
+            k, v = line.split("=", 1)
+            cur[k] = v.split(" ")
+    return feature_names, trees
+
+
+def test_r_model_dt_tree_contract(tmp_path):
+    """The quantities lgb.model.dt.tree / lgb.importance derive from the
+    model text must agree with the Python Booster's own accounting —
+    gain importance, split counts, and per-tree node structure."""
+    sys.path.insert(0, ROOT)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(3)
+    X = rng.randn(600, 5)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbose": -1, "min_data_in_leaf": 10},
+                    lgb.Dataset(X, y), num_boost_round=5)
+    names, trees = _r_parse_model(bst.model_to_string())
+    assert names == bst.feature_name()
+    assert len(trees) == 5
+
+    # R importance: Gain = sum split_gain, Frequency = split count
+    gain = {}
+    freq = {}
+    for t in trees:
+        nl = int(t["num_leaves"][0])
+        assert len(t["leaf_value"]) == nl
+        assert len(t["split_feature"]) == nl - 1
+        # child-link consistency (node_parent derivation in R): every
+        # internal node except the root appears exactly once as a child
+        children = [int(c) for c in t["left_child"] + t["right_child"]]
+        internal_children = sorted(c for c in children if c >= 0)
+        assert internal_children == list(range(1, nl - 1))
+        leaf_children = sorted(-c - 1 for c in children if c < 0)
+        assert leaf_children == list(range(nl))
+        for fi, g in zip(t["split_feature"], t["split_gain"]):
+            fname = names[int(fi)]
+            gain[fname] = gain.get(fname, 0.0) + float(g)
+            freq[fname] = freq.get(fname, 0) + 1
+    py_gain = bst.feature_importance("gain")
+    py_split = bst.feature_importance("split")
+    for i, nm in enumerate(names):
+        np.testing.assert_allclose(gain.get(nm, 0.0), py_gain[i],
+                                   rtol=1e-4)
+        assert freq.get(nm, 0) == py_split[i]
+
+
+def test_r_cv_eval_line_contract(tmp_path):
+    """lgb.cv aggregates the CLI's per-iteration eval lines; every
+    training iteration must emit a line matching the R regex."""
+    rng = np.random.RandomState(1)
+    n = 400
+    x = rng.randn(n, 4)
+    y = (x[:, 0] > 0).astype(float)
+    train = tmp_path / "cv_train.tsv"
+    valid = tmp_path / "cv_valid.tsv"
+    np.savetxt(train, np.column_stack([y[:300], x[:300]]), delimiter="\t")
+    np.savetxt(valid, np.column_stack([y[300:], x[300:]]), delimiter="\t")
+    model = tmp_path / "cv_model.txt"
+    conf = tmp_path / "cv.conf"
+    conf.write_text("\n".join([
+        "objective = binary", "metric = binary_logloss", "num_leaves = 7",
+        "metric_freq = 1", "task = train", f"data = {train}",
+        f"valid = {valid}", "num_trees = 5",
+        f"output_model = {model}"]))
+    r = _cli([f"config={conf}"], str(tmp_path))
+    assert r.returncode == 0, r.stderr
+    # the R parser matches the payload anywhere in the line (the CLI
+    # logger prefixes "[LightGBM-TPU] [Info] ")
+    pat = re.compile(r"Iteration:(\d+), (\S+) (\S+) : ([-+0-9.eE]+)$")
+    rows = [pat.search(l) for l in r.stdout.splitlines()]
+    rows = [m for m in rows if m]
+    iters = [int(m.group(1)) for m in rows]
+    assert iters == list(range(1, 6)), r.stdout
+    assert all(m.group(3) == "binary_logloss" for m in rows)
+    vals = [float(m.group(4)) for m in rows]
+    assert vals[-1] < vals[0]

@@ -11,15 +11,15 @@ from lightgbm_tpu import LGBMClassifier, LGBMRegressor, LGBMRanker
 
 def test_regressor(regression_example):
     X, y, Xt, yt = regression_example
-    reg = LGBMRegressor(n_estimators=14, min_child_samples=10)
-    reg.fit(X, y, eval_set=[(Xt, yt)], verbose=False)
+    reg = LGBMRegressor(n_estimators=10, min_child_samples=10)
+    reg.fit(X, y, verbose=False)
     mse = np.mean((reg.predict(Xt) - yt) ** 2)
     assert mse < 1.0
 
 
 def test_classifier(binary_example):
     X, y, Xt, yt = binary_example
-    clf = LGBMClassifier(n_estimators=14, min_child_samples=10)
+    clf = LGBMClassifier(n_estimators=10, min_child_samples=10)
     clf.fit(X, y, verbose=False)
     proba = clf.predict_proba(Xt)
     assert proba.shape == (len(yt), 2)
@@ -68,7 +68,7 @@ def test_custom_objective(regression_example):
         return (preds - labels).astype(np.float32), \
             np.ones_like(preds, np.float32)
 
-    reg = LGBMRegressor(n_estimators=15, objective=l2_obj,
+    reg = LGBMRegressor(n_estimators=10, objective=l2_obj,
                         min_child_samples=10)
     reg.fit(X, y, verbose=False)
     assert np.mean((reg.predict(Xt) - yt) ** 2) < 1.5

@@ -46,7 +46,7 @@ def test_header_and_label_column(tmp_path):
     assert d2.feature_names == ["a", "b", "c", "d"]
 
 
-@pytest.mark.quick
+@pytest.mark.slow
 def test_sampled_reservoir_statistics(tmp_path):
     """With a sample smaller than the file, the reservoir still produces
     near-identical bin boundaries (same data distribution)."""
