@@ -40,9 +40,13 @@
   if (stratified && !is.null(y)) {
     idx <- seq_len(n)
     fold_of <- integer(n)
+    offset <- 0L
     for (cls in unique(y)) {
       members <- sample(idx[y == cls])
-      fold_of[members] <- rep_len(seq_len(nfold), length(members))
+      # rotate the starting fold per class: without the offset every
+      # class's remainder members land in fold 1, skewing fold sizes
+      fold_of[members] <- ((seq_along(members) - 1L + offset) %% nfold) + 1L
+      offset <- offset + length(members)
     }
   } else {
     fold_of <- sample(rep_len(seq_len(nfold), n))
@@ -55,6 +59,11 @@ lgb.cv <- function(params = list(), data, nrounds = 100L, nfold = 5L,
                    early_stopping_rounds = NULL, showsd = TRUE,
                    verbose = 1L) {
   if (!inherits(data, "lgb.Dataset")) stop("data must be an lgb.Dataset")
+  if (is.character(data$data) && length(data$data) == 1L) {
+    stop("lgb.cv needs an in-memory matrix dataset to build folds; ",
+         "load the file first (e.g. read.table) and pass ",
+         "lgb.Dataset(x, label = y)")
+  }
   x <- as.matrix(data$data)
   y <- data$label
   n <- nrow(x)
@@ -66,6 +75,13 @@ lgb.cv <- function(params = list(), data, nrounds = 100L, nfold = 5L,
   # data feed — a user verbose=-1 must not starve the aggregation (R-side
   # quieting is the separate `verbose` argument)
   params$verbose <- 1L
+  # CLI-side early stopping would desynchronize per-fold iteration
+  # counts and corrupt the aggregation; stopping is client-side here
+  # (the `early_stopping_rounds` argument), like the reference's
+  for (k in c("early_stopping_round", "early_stopping_rounds",
+              "early_stopping", "n_iter_no_change")) {
+    params[[k]] <- NULL
+  }
 
   per_fold <- list()         # fold -> data.frame(iter, metric, value)
   boosters <- list()

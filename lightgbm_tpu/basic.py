@@ -455,6 +455,10 @@ class Booster:
         return self._gbdt.to_json()
 
     def feature_importance(self, importance_type: str = "split") -> np.ndarray:
+        if importance_type not in ("split", "gain"):
+            raise ValueError(
+                f"unknown importance_type {importance_type!r}; "
+                "use 'split' or 'gain'")
         imp = self._gbdt.feature_importance(importance_type)
         names = self.feature_name()
         dt = np.float64 if importance_type == "gain" else np.int64

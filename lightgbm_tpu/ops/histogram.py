@@ -83,6 +83,19 @@ def hist_xla(gb: jax.Array, vals: jax.Array, *, num_bins_padded: int,
 
 FEATURE_GROUP = 8  # features per kernel block (TPU second-minor tiling)
 
+
+def _feature_group_from_env() -> int:
+    """LGBT_FEATURE_GROUP overrides the int32-bin feature-block height
+    for on-chip tuning (wide-feature shapes recompute the [Mp, Ck] vals
+    block once per feature block — a taller block amortizes that over
+    more features at the cost of more VMEM per grid cell).  Clamped to
+    a multiple of 8 in [8, 64]."""
+    try:
+        v = int(_os.environ.get("LGBT_FEATURE_GROUP", "") or FEATURE_GROUP)
+    except ValueError:
+        return FEATURE_GROUP
+    return max(8, min(64, (v // 8) * 8))
+
 # Row-chunk length per pallas grid cell.  Larger chunks amortize grid
 # overhead; VMEM per cell stays small (one-hot [CK, B] + vals [M, CK]).
 # Env-tunable for on-chip experiments; parsed defensively and rounded to
@@ -651,8 +664,10 @@ def hist_multileaf_masked(gb_t: jax.Array, lid: jax.Array, gh8: jax.Array,
                          axis=2).transpose(1, 0, 2, 3)
 
     # int8 bins keep their narrow dtype into the kernel; the int8 VMEM
-    # tile is (32, 128), so the feature-group sublane dim grows to 32
-    G = 32 if bin_offset else FEATURE_GROUP
+    # tile is (32, 128), so the feature-group sublane dim grows to 32.
+    # The int32 path reads LGBT_FEATURE_GROUP (process-start value: the
+    # flag is trace-time, like the narrow-kernel switches)
+    G = 32 if bin_offset else _feature_group_from_env()
     Ck = min(C, MASKED_HIST_CHUNK)
     if bin_offset:
         # the G=32 layout quadruples the per-cell output block

@@ -133,9 +133,12 @@ def create_tree_digraph(booster, tree_index: int = 0, show_info=None,
     def add(node, parent=None, decision=None):
         if "split_index" in node:
             nid = f"split{node['split_index']}"
+            # dump_model carries the reference's JSON type names
+            # ("no_greater"/"is", tree.cpp:347); plot the operator symbol
+            op = {"no_greater": "<=", "is": "=="}.get(
+                node.get("decision_type"), "<=")
             label = (f"feature {node['split_feature']}\n"
-                     f"{node.get('decision_type', '<=')} "
-                     f"{node['threshold']:g}")
+                     f"{op} {node['threshold']:g}")
             if "split_gain" in show_info:
                 label += f"\ngain: {node['split_gain']:g}"
             if "internal_count" in show_info and "internal_count" in node:

@@ -32,8 +32,9 @@ stage bench_63bin      env BENCH_BINS=63 BENCH_ITERS=12 python bench.py || exit 
 # 4. never-measured at-scale configs (VERDICT #3)
 stage ltr  python scripts/run_ltr_scale.py || exit 1
 stage expo python scripts/run_expo_scale.py || exit 1
-# 5. wide-feature decomposition + sweep rerun (VERDICT #4)
+# 5. wide-feature decomposition + tuning A/B + sweep rerun (VERDICT #4)
 stage eps_profile python scripts/profile_hotpath.py 400000 2000 63 || exit 1
+stage eps_tune python scripts/run_eps_tune.py || exit 1
 stage shapes python scripts/run_shape_sweep.py || exit 1
 # 6. full 500-iter north-star refreshes at HEAD (slowest last)
 stage northstar python scripts/run_northstar.py || exit 1

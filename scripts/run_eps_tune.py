@@ -1,0 +1,93 @@
+"""Epsilon-shape tuning A/B (round-4 verdict ask #4: decompose and
+attack the 6.7 s/iter at 400k x 2000 @ 63 bins).
+
+Each configuration runs in a SUBPROCESS because the tuned flags
+(LGBT_FEATURE_GROUP, LGBT_HIST_CHUNK) are trace-time: a fresh process
+guarantees fresh traces.  Writes eps_tune_measured.json with s/iter
+per configuration.
+
+Env: EPS_ROWS (default 400k), EPS_ITERS (default 8).
+"""
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+ROWS = int(os.environ.get("EPS_ROWS", 400_000))
+ITERS = int(os.environ.get("EPS_ITERS", 8))
+
+WORKER = r"""
+import json, os, sys, time
+sys.path.insert(0, {root!r})
+import numpy as np
+from scripts.run_shape_sweep import make_epsilon
+import lightgbm_tpu as lgb
+
+rows, iters, mb = {rows}, {iters}, {mb}
+X, y = make_epsilon(rows)
+params = {{"objective": "binary", "verbose": -1, "num_leaves": 255,
+          "learning_rate": 0.1, "max_bin": mb, "min_data_in_leaf": 1,
+          "min_sum_hessian_in_leaf": 100.0, "histogram_dtype": "int8"}}
+train = lgb.Dataset(X, y).construct(params)
+bst = lgb.Booster(params, train)
+for _ in range(2):
+    bst.update()
+float(bst._gbdt.train_score.score.sum())
+t0 = time.perf_counter()
+for _ in range(iters):
+    bst.update()
+float(bst._gbdt.train_score.score.sum())
+print("EPS_RESULT", json.dumps({{
+    "s_per_iter": round((time.perf_counter() - t0) / iters, 4)}}))
+"""
+
+CONFIGS = [
+    # (label, env overrides) — G sweep amortizes the per-feature-block
+    # vals recompute; chunk sweep trades VMEM for grid overhead
+    ("baseline_G8", {}),
+    ("G16", {"LGBT_FEATURE_GROUP": "16"}),
+    ("G32", {"LGBT_FEATURE_GROUP": "32"}),
+    ("G16_chunk16k", {"LGBT_FEATURE_GROUP": "16",
+                      "LGBT_HIST_CHUNK": "16384"}),
+    ("narrow_off", {"LGBT_NARROW_ONEHOT": "0"}),
+]
+
+
+def main():
+    from bench import default_backend_alive
+    if not default_backend_alive():
+        print("TPU unreachable; eps tune is chip-only", file=sys.stderr)
+        sys.exit(1)
+    results = {}
+    for mb in (63, 255):
+        for label, env in CONFIGS:
+            if mb == 255 and label not in ("baseline_G8", "G16", "G32"):
+                continue
+            e = dict(os.environ, **env)
+            code = WORKER.format(root=ROOT, rows=ROWS, iters=ITERS, mb=mb)
+            r = subprocess.run([sys.executable, "-c", code], env=e,
+                               capture_output=True, text=True,
+                               timeout=3600)
+            line = [ln for ln in r.stdout.splitlines()
+                    if ln.startswith("EPS_RESULT")]
+            if r.returncode != 0 or not line:
+                results[f"{label}@{mb}bins"] = {
+                    "error": (r.stderr or r.stdout)[-400:]}
+                print(f"{label}@{mb}bins FAILED", flush=True)
+                continue
+            res = json.loads(line[0].split(" ", 1)[1])
+            results[f"{label}@{mb}bins"] = res
+            print(f"{label}@{mb}bins: {res['s_per_iter']} s/iter",
+                  flush=True)
+    out = {"rows": ROWS, "features": 2000, "iters": ITERS,
+           "results": results}
+    with open(os.path.join(ROOT, "eps_tune_measured.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print("wrote eps_tune_measured.json")
+
+
+if __name__ == "__main__":
+    main()

@@ -134,3 +134,27 @@ def test_dump_model_field_parity(model):
                   "tree_structure"):
             assert k in ti, k
         walk(ti["tree_structure"])
+
+
+def test_python_api_doc_in_sync(tmp_path):
+    """docs/Python-API.md is generated from the live package; drift
+    fails here (same sandbox pattern as the Parameters.md check)."""
+    import shutil
+    import subprocess
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    gen = os.path.join(root, "scripts", "gen_python_api_doc.py")
+    sandbox = tmp_path / "repo"
+    (sandbox / "scripts").mkdir(parents=True)
+    (sandbox / "docs").mkdir()
+    shutil.copy(gen, sandbox / "scripts" / "gen_python_api_doc.py")
+    env = dict(os.environ, PYTHONPATH=root)
+    r = subprocess.run([sys.executable, str(sandbox / "scripts" /
+                                            "gen_python_api_doc.py")],
+                       capture_output=True, text=True, timeout=180,
+                       env=env)
+    assert r.returncode == 0, r.stderr
+    fresh = (sandbox / "docs" / "Python-API.md").read_text()
+    tracked = open(os.path.join(root, "docs", "Python-API.md")).read()
+    assert fresh == tracked, \
+        "docs/Python-API.md is stale; run scripts/gen_python_api_doc.py"
