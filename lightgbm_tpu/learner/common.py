@@ -101,15 +101,26 @@ def _default_pool_budget() -> float:
     """Unset histogram_pool_size defaults to a quarter of the device's
     memory when the backend reports it (16 GB v5e -> 4 GB: Epsilon-scale
     [255, 2000, 3, 256] caches fit and keep the 2x-cheaper subtraction
-    path), else a conservative 1.5 GB."""
+    path).  Remote-attached TPU plugins may not implement
+    memory_stats() — every TPU this targets has >= 16 GB HBM, so the
+    TPU fallback stays 4 GB (the round-4 Epsilon 255-bin sweep fell
+    into bounded mode, 2x histogram passes, exactly because the
+    tunneled backend reported no stats and the old fallback was
+    1.5 GB); non-TPU hosts keep the conservative 1.5 GB."""
     try:
         import jax
+        on_tpu = jax.default_backend() == "tpu"
+    except Exception:
+        return 1.5e9
+    try:
+        # remote plugins may RAISE (not return empty) from memory_stats;
+        # the TPU fallback must survive either failure mode
         stats = jax.devices()[0].memory_stats()
         if stats and stats.get("bytes_limit"):
             return max(1.5e9, 0.25 * float(stats["bytes_limit"]))
     except Exception:
         pass
-    return 1.5e9
+    return 4e9 if on_tpu else 1.5e9
 
 
 def use_parent_hist_cache(cfg: Config, num_features: int,

@@ -59,7 +59,12 @@ def _load_or_synth():
         return d["X"], d["y"]
     X, y = synth_expo(ROWS)
     os.makedirs(os.path.dirname(cache), exist_ok=True)
-    np.savez(cache, X=X, y=y)
+    # atomic write: a concurrent reader (e.g. the chip queue starting
+    # while a pre-generation run is finishing) must never see a partial
+    # npz
+    tmp = f"{cache}.tmp.{os.getpid()}.npz"   # unique per writer
+    np.savez(tmp, X=X, y=y)
+    os.replace(tmp, cache)
     return X, y
 
 

@@ -158,3 +158,15 @@ def test_python_api_doc_in_sync(tmp_path):
     tracked = open(os.path.join(root, "docs", "Python-API.md")).read()
     assert fresh == tracked, \
         "docs/Python-API.md is stale; run scripts/gen_python_api_doc.py"
+
+
+def test_feature_group_env_clamping(monkeypatch):
+    """LGBT_FEATURE_GROUP parses defensively: multiples of 8 in [8, 64],
+    junk falls back to the default."""
+    from lightgbm_tpu.ops.histogram import _feature_group_from_env
+    monkeypatch.delenv("LGBT_FEATURE_GROUP", raising=False)
+    assert _feature_group_from_env() == 8
+    for raw, want in (("16", 16), ("64", 64), ("100", 64), ("12", 8),
+                      ("junk", 8), ("0", 8)):
+        monkeypatch.setenv("LGBT_FEATURE_GROUP", raw)
+        assert _feature_group_from_env() == want, raw
