@@ -61,10 +61,16 @@ def _load_or_synth():
     os.makedirs(os.path.dirname(cache), exist_ok=True)
     # atomic write: a concurrent reader (e.g. the chip queue starting
     # while a pre-generation run is finishing) must never see a partial
-    # npz
-    tmp = f"{cache}.tmp.{os.getpid()}.npz"   # unique per writer
-    np.savez(tmp, X=X, y=y)
-    os.replace(tmp, cache)
+    # npz; unique tmp per writer, removed on failure (a dead writer must
+    # not leak a ~31 GB orphan)
+    tmp = f"{cache}.tmp.{os.getpid()}.npz"
+    try:
+        np.savez(tmp, X=X, y=y)
+        os.replace(tmp, cache)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+        raise
     return X, y
 
 
@@ -81,9 +87,34 @@ def main():
               "min_data_in_leaf": 1, "min_sum_hessian_in_leaf": 100.0,
               "histogram_dtype": "bfloat16",
               "categorical_feature": list(range(F))}
+    # host binning of 11M x 700 costs ~25 min — a pre-binned store turns
+    # it into a ~80 s load so the chip window is spent training.  The
+    # cold path self-heals: it writes the cache after binning.
+    bin_cache = os.path.join(ROOT, ".bench", f"expo_binned_{ROWS}x{F}.bin")
     t0 = time.perf_counter()
-    train = lgb.Dataset(X, y, categorical_feature=list(range(F))
-                        ).construct(params)
+    if os.path.exists(bin_cache):
+        from lightgbm_tpu.capi import _wrap_inner
+        from lightgbm_tpu.dataset import Dataset as RawDataset
+        from lightgbm_tpu.config import config_from_params
+        inner = RawDataset.from_binary(bin_cache,
+                                       config_from_params(params))
+        # the cache is keyed only by shape: guard against a stale store
+        # whose labels no longer match the (re)generated workload
+        assert np.array_equal(np.asarray(inner.metadata.label,
+                                         np.float64), y), \
+            f"stale {bin_cache}: labels differ from the generated data"
+        train = _wrap_inner(inner, params)
+    else:
+        train = lgb.Dataset(X, y, categorical_feature=list(range(F))
+                            ).construct(params)
+        tmp = f"{bin_cache}.tmp.{os.getpid()}"
+        try:
+            train._inner.save_binary(tmp)
+            os.replace(tmp, bin_cache)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            raise
     t_bin = time.perf_counter() - t0
     bst = lgb.Booster(params, train)
     for _ in range(WARMUP):
