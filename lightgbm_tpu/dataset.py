@@ -568,7 +568,6 @@ class Dataset:
 
     def save_binary(self, path: str) -> None:
         """Serialize the binned dataset so reloads skip parse+bin."""
-        import io
         md = self.metadata
         arrays = {
             "bins": self.bins,
@@ -592,11 +591,12 @@ class Dataset:
                 [m.min_val, m.max_val, m.sparse_rate], np.float64)
             arrays[f"m{i}_upper"] = np.asarray(m.bin_upper_bound, np.float64)
             arrays[f"m{i}_cats"] = np.asarray(m.bin_2_categorical, np.int64)
-        buf = io.BytesIO()
-        np.savez_compressed(buf, **arrays)
+        # stream straight to disk: at Expo scale (11M x 700) a BytesIO
+        # staging copy would add a multi-GB compressed buffer to peak
+        # RSS at exactly the moment the raw matrix is also resident
         with open(path, "wb") as f:
             f.write(self.BINARY_MAGIC.encode() + b"\n")
-            f.write(buf.getvalue())
+            np.savez_compressed(f, **arrays)
 
     @classmethod
     def from_binary(cls, path: str, config: Optional[Config] = None

@@ -31,7 +31,8 @@ X, y = make_epsilon(rows)
 params = {{"objective": "binary", "verbose": -1, "num_leaves": 255,
           "learning_rate": 0.1, "max_bin": mb, "min_data_in_leaf": 1,
           "min_sum_hessian_in_leaf": 100.0, "histogram_dtype": "int8"}}
-train = lgb.Dataset(X, y).construct(params)
+from bench import binned_dataset
+train = binned_dataset("epsilon-shaped", X, y, params)
 bst = lgb.Booster(params, train)
 for _ in range(2):
     bst.update()

@@ -87,34 +87,13 @@ def main():
               "min_data_in_leaf": 1, "min_sum_hessian_in_leaf": 100.0,
               "histogram_dtype": "bfloat16",
               "categorical_feature": list(range(F))}
-    # host binning of 11M x 700 costs ~25 min — a pre-binned store turns
-    # it into a ~80 s load so the chip window is spent training.  The
-    # cold path self-heals: it writes the cache after binning.
-    bin_cache = os.path.join(ROOT, ".bench", f"expo_binned_{ROWS}x{F}.bin")
+    # host binning of 11M x 700 costs ~25 min — the shared binned-store
+    # cache (bench.binned_dataset: load ~80 s, label-checked, bad caches
+    # self-heal by rebinning) keeps the chip window for training
+    from bench import binned_dataset
     t0 = time.perf_counter()
-    if os.path.exists(bin_cache):
-        from lightgbm_tpu.capi import _wrap_inner
-        from lightgbm_tpu.dataset import Dataset as RawDataset
-        from lightgbm_tpu.config import config_from_params
-        inner = RawDataset.from_binary(bin_cache,
-                                       config_from_params(params))
-        # the cache is keyed only by shape: guard against a stale store
-        # whose labels no longer match the (re)generated workload
-        assert np.array_equal(np.asarray(inner.metadata.label,
-                                         np.float64), y), \
-            f"stale {bin_cache}: labels differ from the generated data"
-        train = _wrap_inner(inner, params)
-    else:
-        train = lgb.Dataset(X, y, categorical_feature=list(range(F))
-                            ).construct(params)
-        tmp = f"{bin_cache}.tmp.{os.getpid()}"
-        try:
-            train._inner.save_binary(tmp)
-            os.replace(tmp, bin_cache)
-        except BaseException:
-            if os.path.exists(tmp):
-                os.remove(tmp)
-            raise
+    train = binned_dataset("expo", X, y, params,
+                           categorical_feature=list(range(F)))
     t_bin = time.perf_counter() - t0
     bst = lgb.Booster(params, train)
     for _ in range(WARMUP):

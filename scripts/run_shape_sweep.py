@@ -60,7 +60,8 @@ def run_case(name, X, y, max_bin):
               "min_data_in_leaf": 1, "min_sum_hessian_in_leaf": 100.0,
               "histogram_dtype": HIST_DTYPE}
     t0 = time.perf_counter()
-    train = lgb.Dataset(X, y).construct(params)
+    from bench import binned_dataset
+    train = binned_dataset(name, X, y, params)
     t_bin = time.perf_counter() - t0
     bst = lgb.Booster(params, train)
     for _ in range(WARMUP):
