@@ -62,7 +62,8 @@ HIST_DTYPE = os.environ.get("BENCH_HIST_DTYPE", "int8")
 BINS = int(os.environ.get("BENCH_BINS", 255))
 
 
-def binned_dataset(tag, X, y, params, categorical_feature="auto"):
+def binned_dataset(tag, X, y, params, categorical_feature="auto",
+                   group=None):
     """lgb.Dataset for (X, y) backed by a binned-store cache keyed by
     tag/shape/max_bin (.bench/<tag>_binned_<N>x<F>_b<bins>.bin).
 
@@ -85,15 +86,17 @@ def binned_dataset(tag, X, y, params, categorical_feature="auto"):
         try:
             inner = RawDataset.from_binary(cache,
                                            config_from_params(params))
-            if np.array_equal(np.asarray(inner.metadata.label, np.float64),
-                              np.asarray(y, np.float64)):
+            # compare in float32 — the store's label dtype — so labels
+            # that aren't f32-exact don't make the cache permanently miss
+            if np.array_equal(np.asarray(inner.metadata.label, np.float32),
+                              np.asarray(y, np.float32)):
                 return _wrap_inner(inner, params)
             reason = "labels differ"
         except Exception as e:
             reason = f"unreadable: {e}"
         print(f"stale bin cache {cache} ({reason}); rebinning",
               file=sys.stderr)
-    ds = lgb.Dataset(X, y,
+    ds = lgb.Dataset(X, y, group=group,
                      categorical_feature=categorical_feature
                      ).construct(params)
     os.makedirs(os.path.dirname(cache), exist_ok=True)

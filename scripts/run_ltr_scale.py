@@ -59,7 +59,8 @@ def run_workload(name, rows, test_rows, f, avg_q):
     X, y, q = synth_ltr(rows, f=f, seed=0, avg_q=avg_q)
     Xt, yt, qt = synth_ltr(test_rows, f=f, seed=5, avg_q=avg_q)
     t0 = time.perf_counter()
-    train = lgb.Dataset(X, y, group=q).construct(params)
+    from bench import binned_dataset
+    train = binned_dataset(f"ltr-{name}", X, y, params, group=q)
     valid = lgb.Dataset(Xt, yt, group=qt, reference=train).construct(params)
     t_bin = time.perf_counter() - t0
 
