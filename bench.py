@@ -88,10 +88,20 @@ def binned_dataset(tag, X, y, params, categorical_feature="auto",
                                            config_from_params(params))
             # compare in float32 — the store's label dtype — so labels
             # that aren't f32-exact don't make the cache permanently miss
-            if np.array_equal(np.asarray(inner.metadata.label, np.float32),
-                              np.asarray(y, np.float32)):
+            labels_ok = np.array_equal(
+                np.asarray(inner.metadata.label, np.float32),
+                np.asarray(y, np.float32))
+            qb = inner.metadata.query_boundaries
+            if group is None:
+                groups_ok = qb is None or len(qb) <= 1
+            else:
+                want = np.concatenate([[0], np.cumsum(group)])
+                groups_ok = qb is not None and np.array_equal(
+                    np.asarray(qb, np.int64), want.astype(np.int64))
+            if labels_ok and groups_ok:
                 return _wrap_inner(inner, params)
-            reason = "labels differ"
+            reason = ("labels differ" if not labels_ok
+                      else "query groups differ")
         except Exception as e:
             reason = f"unreadable: {e}"
         print(f"stale bin cache {cache} ({reason}); rebinning",
