@@ -2,9 +2,12 @@
 # Round-5 chip measurement queue.  Run when the TPU tunnel is alive;
 # each stage writes its own artifact and a stage marker, so a mid-queue
 # tunnel wedge loses only the running stage (rerun resumes after the
-# last marker).  Order = round-4 VERDICT priority: validate the round-4
-# kernels first, then the 63-bin variant, then the never-measured
-# at-scale configs, then the slow full refreshes.
+# last marker).  Order = value-per-minute under a possibly short
+# window: the tracked bench number and kernel A/B first, the full
+# 500-iter refreshes next, the never-measured scale configs, then the
+# wide-feature tuning sweeps (longest, most exploratory) last.
+# Every dataset is pre-binned in .bench/*_binned_*.bin, so stages spend
+# their time on the chip, not the host.
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 MARK=.bench/chip_queue_done
@@ -20,15 +23,16 @@ stage() {  # stage <name> <cmd...>  (stdout tees to .bench/<name>.log)
   else echo "!! $name FAILED (tunnel?)"; return 1; fi
 }
 
-# 1. kernel-level profile at HEAD (narrow one-hot in)
-stage profile python scripts/profile_hotpath.py || exit 1
-# 2. short full-shape A/B: round-4 kernels on (default) vs each off
+# 1. the tracked metric at HEAD + the round-4 kernel A/B (VERDICT #1)
 stage bench_narrow_on  env BENCH_ITERS=12 python bench.py || exit 1
+stage profile python scripts/profile_hotpath.py || exit 1
 stage bench_narrow_off env LGBT_NARROW_ONEHOT=0 BENCH_ITERS=12 python bench.py || exit 1
 stage bench_part_off   env LGBT_FUSED_PARTITION=0 BENCH_ITERS=12 python bench.py || exit 1
-stage bench_chunk16k   env LGBT_HIST_CHUNK=16384 BENCH_ITERS=12 python bench.py || exit 1
-# 3. the 63-bin variant (VERDICT #2: reference accelerator sweet spot)
+# 2. the 63-bin variant (VERDICT #2: reference accelerator sweet spot)
 stage bench_63bin      env BENCH_BINS=63 BENCH_ITERS=12 python bench.py || exit 1
+# 3. full 500-iter north-star refreshes at HEAD
+stage northstar python scripts/run_northstar.py || exit 1
+stage northstar63 env NS_BINS=63 python scripts/run_northstar.py || exit 1
 # 4. never-measured at-scale configs (VERDICT #3)
 stage ltr  python scripts/run_ltr_scale.py || exit 1
 stage expo python scripts/run_expo_scale.py || exit 1
@@ -36,7 +40,6 @@ stage expo python scripts/run_expo_scale.py || exit 1
 stage eps_profile python scripts/profile_hotpath.py 400000 2000 63 || exit 1
 stage eps_tune python scripts/run_eps_tune.py || exit 1
 stage shapes python scripts/run_shape_sweep.py || exit 1
-# 6. full 500-iter north-star refreshes at HEAD (slowest last)
-stage northstar python scripts/run_northstar.py || exit 1
-stage northstar63 env NS_BINS=63 python scripts/run_northstar.py || exit 1
+# 6. chunk sweep (lowest priority)
+stage bench_chunk16k   env LGBT_HIST_CHUNK=16384 BENCH_ITERS=12 python bench.py || exit 1
 echo "ALL STAGES DONE $(date +%H:%M:%S)"
