@@ -41,5 +41,14 @@ __all__ = [
     "train", "cv", "early_stopping", "print_evaluation", "record_evaluation",
     "reset_parameter", "LGBMModel", "LGBMRegressor", "LGBMClassifier",
     "LGBMRanker", "plot_importance", "plot_metric", "plot_tree",
-    "create_tree_digraph",
+    "create_tree_digraph", "serving",
 ]
+
+
+def __getattr__(name):
+    # the online-prediction subsystem is imported on first use so the
+    # training/CLI import path stays free of server machinery
+    if name == "serving":
+        import importlib
+        return importlib.import_module(".serving", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

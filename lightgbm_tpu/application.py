@@ -4,8 +4,9 @@ Mirrors the reference Application (/root/reference/src/application/
 application.cpp:46-248, main.cpp): parse key=value argv + config file,
 task=train → load data/valid sets, boost with per-iteration metric output
 and wall-clock logging, save model; task=predict → batch-score a data file
-to output_result.  The reference examples' train.conf/predict.conf run
-unmodified.
+to output_result; task=serve → online JSON-lines HTTP scoring
+(lightgbm_tpu/serving/).  The reference examples' train.conf/predict.conf
+run unmodified.
 """
 from __future__ import annotations
 
@@ -59,6 +60,8 @@ class Application:
             self._train()
         elif self.config.task in ("predict", "prediction", "test"):
             self._predict()
+        elif self.config.task in ("serve", "serving"):
+            self._serve()
         else:
             raise LightGBMError(f"unknown task: {self.config.task}")
 
@@ -125,6 +128,9 @@ class Application:
             raise LightGBMError("no prediction data: set data=<file>")
         if not cfg.input_model:
             raise LightGBMError("no model: set input_model=<file>")
+        # one Booster + one compiled-predictor runtime for the whole
+        # task: every file/chunk shares the stacked trees and the warm
+        # executables instead of rebuilding the TreeStack per call
         bst = Booster(model_file=cfg.input_model)
         predictor = Predictor(bst, raw_score=cfg.is_predict_raw_score,
                               leaf_index=cfg.is_predict_leaf_index,
@@ -135,22 +141,46 @@ class Application:
         _log(cfg, f"finished prediction, results saved to "
                   f"{cfg.output_result}")
 
+    # ------------------------------------------------------------------
+    def _serve(self) -> None:
+        from .serving.server import serve_from_config
+        serve_from_config(self.config)
+
 
 class Predictor:
     """Batch file prediction (reference predictor.hpp:24-159): parse the
-    input file, score every row, write one prediction per line."""
+    input file, score every row, write one prediction per line.
+
+    Value/raw scoring runs through a shared `serving.PredictorRuntime`,
+    so the CLI batch path and the online server hit the same compiled-
+    executable cache: chunks are padded to power-of-two row buckets and
+    never retrace on a leftover shape.  Leaf-index output keeps the host
+    walk (exact int semantics, no device analog yet)."""
 
     def __init__(self, booster: Booster, raw_score: bool = False,
-                 leaf_index: bool = False, num_iteration: int = -1):
+                 leaf_index: bool = False, num_iteration: int = -1,
+                 runtime=None):
         self.booster = booster
         self.raw_score = raw_score
         self.leaf_index = leaf_index
         self.num_iteration = num_iteration
+        gbdt = getattr(booster, "_gbdt", booster)
+        gbdt._flush_pending()
+        if runtime is None and not leaf_index and gbdt.models:
+            # zero-tree models keep the host path: Booster.predict
+            # returns the baseline score, nothing to compile
+            from .serving.runtime import PredictorRuntime
+            runtime = PredictorRuntime(booster, num_iteration=num_iteration,
+                                       max_batch_rows=262_144)
+        self.runtime = runtime
 
     def predict(self, X: np.ndarray) -> np.ndarray:
         if self.leaf_index:
             return self.booster.predict(X, num_iteration=self.num_iteration,
                                         pred_leaf=True)
+        if self.runtime is not None:
+            return self.runtime.predict(
+                X, kind="raw" if self.raw_score else "value")
         return self.booster.predict(X, num_iteration=self.num_iteration,
                                     raw_score=self.raw_score)
 

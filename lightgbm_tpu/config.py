@@ -94,6 +94,17 @@ PARAM_ALIASES: Dict[str, str] = {
     "unbalanced_sets": "is_unbalance",
     # extra alias of this package
     "tree_learner_type": "tree_learner",
+    # serving subsystem (task=serve)
+    "serving_port": "serve_port",
+    "predict_port": "serve_port",
+    "serving_host": "serve_host",
+    "serve_address": "serve_host",
+    "batch_rows": "max_batch_rows",
+    "serve_max_batch_rows": "max_batch_rows",
+    "flush_deadline": "flush_deadline_ms",
+    "serve_flush_deadline_ms": "flush_deadline_ms",
+    "model_poll": "model_poll_seconds",
+    "poll_seconds": "model_poll_seconds",
 }
 
 # objective name aliases (reference config.cpp GetObjectiveType handling)
@@ -265,6 +276,14 @@ class Config:
     # prediction
     num_iteration_predict: int = -1
 
+    # -- online serving (task=serve, lightgbm_tpu/serving/)
+    serve_host: str = "127.0.0.1"
+    serve_port: int = 8080
+    max_batch_rows: int = 4096        # micro-batch coalescing cap
+    flush_deadline_ms: float = 5.0    # max wait before a partial flush
+    model_poll_seconds: float = 10.0  # hot-swap mtime poll (0 = off)
+    min_bucket_rows: int = 16         # smallest padded row bucket
+
     # fields that are parsed but unused on TPU (accepted for compat)
     config_file: str = ""
     output_freq: int = 1
@@ -369,6 +388,16 @@ def check_param_conflict(cfg: Config) -> None:
         raise ValueError(f"unknown tree_learner: {cfg.tree_learner}")
     if cfg.tree_growth not in ("auto", "exact", "rounds"):
         raise ValueError(f"unknown tree_growth: {cfg.tree_growth}")
+    if not (0 <= cfg.serve_port <= 65535):
+        raise ValueError("serve_port must be in [0, 65535]")
+    if cfg.max_batch_rows < 1:
+        raise ValueError("max_batch_rows must be >= 1")
+    if cfg.min_bucket_rows < 1:
+        raise ValueError("min_bucket_rows must be >= 1")
+    if cfg.flush_deadline_ms < 0:
+        raise ValueError("flush_deadline_ms must be >= 0")
+    if cfg.model_poll_seconds < 0:
+        raise ValueError("model_poll_seconds must be >= 0")
 
 
 def parse_config_file(path: str) -> Dict[str, str]:

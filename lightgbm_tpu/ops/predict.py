@@ -57,6 +57,31 @@ def stack_trees(trees, binned: bool) -> TreeStack:
     return TreeStack(*map(jnp.asarray, (sf, th, dc, lc, rc, lv, nl)))
 
 
+def _walk_one_tree(sf, th, dc, lc, rc, lv, nl, Xf, depth: int) -> jax.Array:
+    """Leaf values for every row of one tree ([N] f32): all rows advance
+    one level per step, gathers instead of pointer dereferences."""
+    n0 = jnp.where(nl < 2, jnp.int32(-1), jnp.int32(0))  # stumps: leaf 0
+    node = jnp.full(Xf.shape[0], n0, jnp.int32)
+
+    def step(_, node):
+        safe = jnp.maximum(node, 0)
+        f = sf[safe]
+        v = jnp.take_along_axis(Xf, f[:, None], axis=1)[:, 0]
+        t = th[safe]
+        cat = dc[safe] == 1
+        # categorical: int truncation compare, matching the host walk
+        # (tree.py predict_leaf_index: v.astype(int64) == thr int64)
+        gl = jnp.where(cat,
+                       v.astype(jnp.int32) == t.astype(jnp.int32),
+                       v <= t)
+        nxt = jnp.where(gl, lc[safe], rc[safe])
+        return jnp.where(node >= 0, nxt, node)
+
+    node = jax.lax.fori_loop(0, depth, step, node)
+    leaf = jnp.where(node < 0, ~node, 0)
+    return lv[leaf]
+
+
 @functools.partial(jax.jit, static_argnames=("depth",))
 def predict_trees(stack: TreeStack, X: jax.Array, *, depth: int) -> jax.Array:
     """Sum of tree outputs for every row.
@@ -69,26 +94,29 @@ def predict_trees(stack: TreeStack, X: jax.Array, *, depth: int) -> jax.Array:
     Xf = X.astype(jnp.float32)
 
     def one_tree(sf, th, dc, lc, rc, lv, nl):
-        n0 = jnp.where(nl < 2, jnp.int32(-1), jnp.int32(0))  # stumps: leaf 0
-        node = jnp.full(Xf.shape[0], n0, jnp.int32)
-
-        def step(_, node):
-            safe = jnp.maximum(node, 0)
-            f = sf[safe]
-            v = jnp.take_along_axis(Xf, f[:, None], axis=1)[:, 0]
-            t = th[safe]
-            cat = dc[safe] == 1
-            # categorical: int truncation compare, matching the host walk
-            # (tree.py predict_leaf_index: v.astype(int64) == thr int64)
-            gl = jnp.where(cat,
-                           v.astype(jnp.int32) == t.astype(jnp.int32),
-                           v <= t)
-            nxt = jnp.where(gl, lc[safe], rc[safe])
-            return jnp.where(node >= 0, nxt, node)
-
-        node = jax.lax.fori_loop(0, depth, step, node)
-        leaf = jnp.where(node < 0, ~node, 0)
-        return lv[leaf]
+        return _walk_one_tree(sf, th, dc, lc, rc, lv, nl, Xf, depth)
 
     vals = jax.vmap(one_tree)(*stack)          # [T, N]
     return jnp.sum(vals, axis=0)
+
+
+def ensemble_raw(stacks, X: jax.Array, *, depths) -> jax.Array:
+    """Raw per-class scores for a multi-class ensemble ([K, N] f32).
+
+    `stacks` is one TreeStack (or None for an untrained class — its row
+    stays zero, matching GBDT._predict_raw_device) per class; `depths`
+    the matching static walk depths.  Traceable: the serving runtime
+    AOT-compiles this once per (generation, row bucket, output kind).
+    """
+    Xf = X.astype(jnp.float32)
+    outs = []
+    for stack, depth in zip(stacks, depths):
+        if stack is None:
+            outs.append(jnp.zeros(Xf.shape[0], jnp.float32))
+            continue
+
+        def one_tree(sf, th, dc, lc, rc, lv, nl, _d=depth):
+            return _walk_one_tree(sf, th, dc, lc, rc, lv, nl, Xf, _d)
+
+        outs.append(jnp.sum(jax.vmap(one_tree)(*stack), axis=0))
+    return jnp.stack(outs)

@@ -11,51 +11,114 @@ from __future__ import annotations
 
 import atexit
 import os
+import threading
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from contextlib import contextmanager
-from typing import Dict, Iterator, Optional
+from typing import Deque, Dict, Iterator, Optional
 
 ENABLED = os.environ.get("LIGHTGBM_TPU_TIMETAG", "0") not in ("0", "", "false")
 
 _totals: Dict[str, float] = defaultdict(float)
 _counts: Dict[str, int] = defaultdict(int)
 
+# Always-on counters and bounded sample reservoirs (the serving layer's
+# request/cache/latency metrics flow through these regardless of the
+# TIMETAG switch — a production /stats endpoint cannot depend on a debug
+# env var).  Guarded by one lock: serving increments from many threads.
+_lock = threading.Lock()
+_counters: Dict[str, float] = defaultdict(float)
+_samples: Dict[str, Deque[float]] = {}
+_SAMPLE_CAP = 4096
+
 
 @contextmanager
-def phase(name: str) -> Iterator[None]:
-    """Accumulate wall-clock under `name`.  No-op unless enabled."""
-    if not ENABLED:
+def phase(name: str, force: bool = False) -> Iterator[None]:
+    """Accumulate wall-clock under `name`.  No-op unless enabled, except
+    `force=True` (serving phases) which always accumulates."""
+    if not (ENABLED or force):
         yield
         return
     t0 = time.perf_counter()
     try:
         yield
     finally:
-        _totals[name] += time.perf_counter() - t0
-        _counts[name] += 1
+        with _lock:
+            _totals[name] += time.perf_counter() - t0
+            _counts[name] += 1
 
 
-def add(name: str, seconds: float) -> None:
-    if ENABLED:
-        _totals[name] += seconds
-        _counts[name] += 1
+def add(name: str, seconds: float, force: bool = False) -> None:
+    if ENABLED or force:
+        with _lock:
+            _totals[name] += seconds
+            _counts[name] += 1
+
+
+def count(name: str, inc: float = 1.0) -> None:
+    """Bump an always-on counter (thread-safe)."""
+    with _lock:
+        _counters[name] += inc
+
+
+def counter_value(name: str) -> float:
+    with _lock:
+        return _counters.get(name, 0.0)
+
+
+def counters(prefix: str = "") -> Dict[str, float]:
+    with _lock:
+        return {k: v for k, v in _counters.items() if k.startswith(prefix)}
+
+
+def observe(name: str, value: float) -> None:
+    """Record one sample into a bounded reservoir (for percentiles)."""
+    with _lock:
+        dq = _samples.get(name)
+        if dq is None:
+            dq = _samples[name] = deque(maxlen=_SAMPLE_CAP)
+        dq.append(value)
+
+
+def summary(name: str) -> Dict[str, float]:
+    """count/p50/p95/max over the retained samples of `name`."""
+    with _lock:
+        vals = sorted(_samples.get(name, ()))
+    if not vals:
+        return {"count": 0}
+    def q(p: float) -> float:
+        return vals[min(len(vals) - 1, int(p * len(vals)))]
+    return {"count": len(vals), "p50": q(0.50), "p95": q(0.95),
+            "max": vals[-1]}
+
+
+def timings() -> Dict[str, float]:
+    """Phase totals without printing (the /stats view of the TIMETAG
+    accumulators)."""
+    with _lock:
+        return dict(_totals)
 
 
 def report() -> Dict[str, float]:
     """Totals per phase; also printed when TIMETAG is on (reference logs
     at destructor time)."""
-    if ENABLED and _totals:
+    with _lock:
+        totals = dict(_totals)
+        counts = dict(_counts)
+    if ENABLED and totals:
         print("[LightGBM-TPU] [Info] ===== timer totals =====", flush=True)
-        for name in sorted(_totals, key=_totals.get, reverse=True):
-            print(f"[LightGBM-TPU] [Info] {name}: {_totals[name]:.4f}s "
-                  f"({_counts[name]} calls)", flush=True)
-    return dict(_totals)
+        for name in sorted(totals, key=totals.get, reverse=True):
+            print(f"[LightGBM-TPU] [Info] {name}: {totals[name]:.4f}s "
+                  f"({counts[name]} calls)", flush=True)
+    return totals
 
 
 def reset() -> None:
-    _totals.clear()
-    _counts.clear()
+    with _lock:
+        _totals.clear()
+        _counts.clear()
+        _counters.clear()
+        _samples.clear()
 
 
 if ENABLED:

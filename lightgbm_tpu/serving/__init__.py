@@ -1,0 +1,24 @@
+"""lightgbm_tpu.serving — TPU-native online prediction.
+
+Four layers, composed bottom-up:
+
+- `runtime`  — PredictorRuntime: AOT-compiled executables cached per
+  (model generation, row bucket, output kind); power-of-two bucketing +
+  padding keeps every request on a warm executable.
+- `batcher`  — MicroBatcher: coalesces concurrent requests up to
+  `max_batch_rows` or a `flush_deadline_ms` deadline, scatters results
+  back per request.
+- `registry` — ModelRegistry: versioned atomic hot-swap (mtime poll or
+  SIGHUP) with pre-swap warmup and rollback on a bad model.
+- `server`   — PredictionServer: stdlib JSON-lines HTTP endpoint
+  (/predict, /healthz, /stats), the `task=serve` CLI entry.
+"""
+from .runtime import PredictorRuntime, row_bucket
+from .batcher import MicroBatcher
+from .registry import ModelRegistry
+from .server import PredictionServer, serve_from_config, server_from_config
+
+__all__ = [
+    "PredictorRuntime", "row_bucket", "MicroBatcher", "ModelRegistry",
+    "PredictionServer", "serve_from_config", "server_from_config",
+]

@@ -1,0 +1,168 @@
+"""Micro-batching request queue.
+
+Online traffic arrives as many small concurrent requests; the TPU wants
+few large shape-stable batches.  The batcher bridges the two: requests
+queue up and a single flusher thread coalesces them until either
+``max_batch_rows`` are pending or the OLDEST request has waited
+``flush_deadline_ms`` — the classic latency/throughput dial of
+accelerator serving stacks.  One runtime reference is pinned per flush,
+so every request in a batch scores against a single model generation
+even while a hot swap lands mid-flight.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Deque, List, Optional
+
+import numpy as np
+
+from .. import profiling
+from ..log import LightGBMError
+
+
+class _Request:
+    __slots__ = ("X", "kind", "future", "t_enqueue")
+
+    def __init__(self, X: np.ndarray, kind: str):
+        self.X = X
+        self.kind = kind
+        self.future: Future = Future()
+        self.t_enqueue = time.perf_counter()
+
+
+class MicroBatcher:
+    """Coalesce concurrent predict requests into bucketed runtime calls.
+
+    `source` is anything with a ``current()`` returning the active
+    PredictorRuntime (a ModelRegistry), or a runtime itself.
+    """
+
+    def __init__(self, source, *, max_batch_rows: int = 4096,
+                 flush_deadline_ms: float = 5.0):
+        self._source = source
+        self.max_batch_rows = max(1, int(max_batch_rows))
+        self.flush_deadline_s = max(0.0, float(flush_deadline_ms)) / 1e3
+        self._cond = threading.Condition()
+        self._queue: Deque[_Request] = deque()
+        self._rows_pending = 0
+        self._closed = False
+        self.batches_flushed = 0
+        self._thread = threading.Thread(target=self._loop,
+                                        name="lgbt-serve-batcher",
+                                        daemon=True)
+        self._thread.start()
+
+    # -- client side ----------------------------------------------------
+
+    def submit(self, X: np.ndarray, kind: str = "value") -> Future:
+        """Enqueue one request; the Future resolves to its predictions
+        (Booster.predict shapes) or raises the scoring error."""
+        X = np.ascontiguousarray(np.asarray(X, np.float64))
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        if X.ndim != 2 or X.shape[0] == 0:
+            raise LightGBMError("predict request must be a non-empty "
+                                "[rows, features] matrix")
+        req = _Request(X, kind)
+        with self._cond:
+            if self._closed:
+                raise LightGBMError("batcher is closed")
+            self._queue.append(req)
+            self._rows_pending += X.shape[0]
+            depth = len(self._queue)
+            self._cond.notify_all()
+        profiling.count("serve.requests")
+        profiling.observe("serve.queue_depth", depth)
+        return req.future
+
+    @property
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    def close(self) -> None:
+        """Stop accepting work, flush what is queued, join the thread."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout=30)
+
+    # -- flusher side ---------------------------------------------------
+
+    def _take_batch(self) -> Optional[List[_Request]]:
+        """Block until a batch is due (rows cap reached, deadline hit, or
+        close); None means closed-and-drained."""
+        with self._cond:
+            while not self._queue:
+                if self._closed:
+                    return None
+                self._cond.wait()
+            deadline = self._queue[0].t_enqueue + self.flush_deadline_s
+            while (self._rows_pending < self.max_batch_rows
+                   and not self._closed):
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                self._cond.wait(timeout=remaining)
+                if not self._queue:          # raced with close+drain
+                    return None if self._closed else []
+            batch: List[_Request] = []
+            rows = 0
+            while self._queue:
+                nxt = self._queue[0].X.shape[0]
+                if batch and rows + nxt > self.max_batch_rows:
+                    break
+                req = self._queue.popleft()
+                rows += req.X.shape[0]
+                batch.append(req)
+            self._rows_pending -= rows
+            return batch
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            if batch:
+                self._flush(batch)
+
+    def _flush(self, batch: List[_Request]) -> None:
+        # pin ONE runtime for the whole batch: no request ever spans a
+        # half-swapped model
+        try:
+            runtime = (self._source.current()
+                       if hasattr(self._source, "current") else self._source)
+        except Exception as e:                     # registry load failure
+            for req in batch:
+                req.future.set_exception(e)
+            return
+        self.batches_flushed += 1
+        profiling.count("serve.batches")
+        # group by (kind, feature width) so a malformed request only
+        # fails its own group, never the neighbors that batched with it
+        groups: dict = {}
+        for req in batch:
+            groups.setdefault((req.kind, req.X.shape[1]), []).append(req)
+        for (kind, _f), reqs in groups.items():
+            X = (reqs[0].X if len(reqs) == 1
+                 else np.concatenate([r.X for r in reqs], axis=0))
+            try:
+                preds = runtime.predict(X, kind=kind)
+            except Exception as e:
+                for req in reqs:
+                    req.future.set_exception(e)
+                continue
+            now = time.perf_counter()
+            off = 0
+            for req in reqs:
+                n = req.X.shape[0]
+                # stamp the scoring generation before set_result so a
+                # waiter that wakes on result() always sees it
+                req.future.generation = getattr(runtime, "generation", 0)
+                req.future.set_result(preds[off:off + n])
+                off += n
+                profiling.observe("serve.latency_ms",
+                                  (now - req.t_enqueue) * 1e3)

@@ -1,0 +1,150 @@
+"""Versioned model registry with atomic hot-swap.
+
+Production serving replaces models under load.  The registry owns the
+active (generation, PredictorRuntime) pair and swaps it atomically:
+
+- `maybe_reload()` polls the model file's (mtime_ns, size) signature —
+  driven by the server's poll thread every `model_poll_seconds`, or
+  forced immediately via SIGHUP (`install_sighup()`);
+- an incoming model is fully loaded AND warmed (every row bucket the
+  outgoing runtime had compiled is re-compiled and executed for the new
+  generation) BEFORE the reference flips, so the first request after a
+  swap is as warm as the last one before it;
+- a model that fails to load or compile is rolled back: the old runtime
+  keeps serving, the bad file signature is remembered so the poll loop
+  does not retry-spin on it, and `serve.swap_failure` is counted.
+
+Readers never lock: `current()` is one attribute read; in-flight batches
+that pinned the previous runtime finish on it untouched.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import threading
+from typing import Optional, Sequence, Tuple
+
+from .. import log, profiling
+from ..log import LightGBMError
+from .runtime import PredictorRuntime
+
+
+def _file_signature(path: str) -> Tuple[int, int]:
+    st = os.stat(path)
+    return (st.st_mtime_ns, st.st_size)
+
+
+class ModelRegistry:
+    def __init__(self, model_path: str, params: Optional[dict] = None, *,
+                 num_iteration: int = -1, max_batch_rows: int = 4096,
+                 min_bucket_rows: int = 16,
+                 warmup_buckets: Sequence[int] = (1,),
+                 warmup_kinds: Sequence[str] = ("value",)):
+        self.model_path = model_path
+        self.params = dict(params or {})
+        self.num_iteration = num_iteration
+        self.max_batch_rows = max_batch_rows
+        self.min_bucket_rows = min_bucket_rows
+        self.warmup_kinds = tuple(warmup_kinds)
+        self._lock = threading.Lock()       # serializes WRITERS only
+        self._failed_sig: Optional[Tuple[int, int]] = None
+        self._hup_pending = False
+        # stat BEFORE loading (like maybe_reload): a file replaced during
+        # a minutes-long load/warmup must look changed on the next poll
+        self._sig = _file_signature(model_path)
+        runtime = self._load(generation=1)
+        runtime.warmup(warmup_buckets, self.warmup_kinds)
+        self._runtime = runtime
+        self.swaps = 0
+        self.swap_failures = 0
+
+    # -- reader side ----------------------------------------------------
+
+    def current(self) -> PredictorRuntime:
+        """The active runtime — a single atomic reference read."""
+        return self._runtime
+
+    @property
+    def generation(self) -> int:
+        return self._runtime.generation
+
+    # -- writer side ----------------------------------------------------
+
+    def _load(self, generation: int) -> PredictorRuntime:
+        from ..basic import Booster
+        booster = Booster(model_file=self.model_path, params=self.params)
+        return PredictorRuntime(booster, num_iteration=self.num_iteration,
+                                max_batch_rows=self.max_batch_rows,
+                                min_bucket_rows=self.min_bucket_rows,
+                                generation=generation)
+
+    def maybe_reload(self, force: bool = False) -> bool:
+        """Swap in the model file if it changed; True iff a swap landed.
+
+        Failure of ANY stage (read, parse, compile, warmup) keeps the
+        old generation serving.
+        """
+        with self._lock:
+            if self._hup_pending:
+                self._hup_pending = False
+                force = True
+            try:
+                sig = _file_signature(self.model_path)
+            except OSError:
+                # mid-replace; don't lose a SIGHUP-forced reload — the
+                # next poll tick must retry with force
+                self._hup_pending = self._hup_pending or force
+                return False
+            if not force and (sig == self._sig or sig == self._failed_sig):
+                return False
+            old = self._runtime
+            try:
+                with profiling.phase("serve/swap", force=True):
+                    runtime = self._load(generation=old.generation + 1)
+                    # warm every bucket the outgoing generation served
+                    buckets = {b for b, _k in old.buckets_compiled()} or {1}
+                    kinds = ({k for _b, k in old.buckets_compiled()}
+                             or set(self.warmup_kinds))
+                    runtime.warmup(sorted(buckets), sorted(kinds))
+            except Exception as e:
+                self.swap_failures += 1
+                self._failed_sig = sig
+                profiling.count("serve.swap_failure")
+                log.warning(f"model hot-swap failed, keeping generation "
+                            f"{old.generation}: {e}")
+                return False
+            self._runtime = runtime          # the atomic swap
+            self._sig = sig
+            self._failed_sig = None
+            self.swaps += 1
+            profiling.count("serve.swap")
+            log.info(f"hot-swapped model to generation "
+                     f"{runtime.generation} ({self.model_path})")
+            return True
+
+    # -- triggers -------------------------------------------------------
+
+    def install_sighup(self) -> bool:
+        """SIGHUP → force reload on the next poll tick.  Only possible
+        from the main thread; returns False (mtime polling still works)
+        otherwise."""
+        if threading.current_thread() is not threading.main_thread():
+            return False
+
+        def _on_hup(_signum, _frame):
+            self._hup_pending = True
+            # reload off-thread immediately: SIGHUP must work even when
+            # mtime polling is disabled, and the handler itself must not
+            # block the main thread on a minutes-long compile
+            threading.Thread(target=self.poll_once, daemon=True,
+                             name="lgbt-serve-hup").start()
+
+        try:
+            signal.signal(signal.SIGHUP, _on_hup)
+        except (ValueError, OSError, AttributeError):
+            return False
+        return True
+
+    def poll_once(self) -> bool:
+        # maybe_reload consumes _hup_pending itself, under the lock
+        return self.maybe_reload()

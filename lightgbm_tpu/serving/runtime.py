@@ -1,0 +1,246 @@
+"""Compiled-predictor runtime for online scoring.
+
+The offline path (`application.Predictor` → `Booster.predict`) re-traces
+the XLA walker for every new batch shape and rebuilds the TreeStack per
+call.  Online traffic is the opposite workload: millions of small,
+odd-shaped requests against one slowly-changing model.  This runtime
+keeps the accelerator executable warm the way the GPU boosting serving
+literature prescribes (arXiv:1806.11248 §5, arXiv:2011.02022):
+
+- executables are AOT-compiled once per (model generation, row bucket,
+  output kind) via ``jax.jit(...).lower(...).compile()`` and cached —
+  a cache hit does zero tracing and zero compilation;
+- request rows are bucketed to powers of two between
+  ``min_bucket_rows`` and ``max_batch_rows`` and padded up, so every
+  shape in the wild lands on one of O(log) warm executables;
+- the per-request feature buffer is donated on accelerator backends, so
+  XLA may reuse it for the output and skip one HBM round trip;
+- the sigmoid/softmax output transform runs inside the compiled program
+  ("value" kind) — the host only sees finished predictions.
+
+Cache hits/misses, compile seconds, and executed rows are recorded
+through the always-on `profiling` counters (exposed at the server's
+/stats endpoint).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import profiling
+from ..log import LightGBMError
+
+OUTPUT_KINDS = ("value", "raw")
+
+
+def row_bucket(n: int, min_bucket: int, max_bucket: int) -> int:
+    """Smallest power-of-two bucket >= n within [min_bucket, max_bucket]."""
+    b = max(1, min_bucket)
+    while b < n and b < max_bucket:
+        b <<= 1
+    return min(b, max_bucket)
+
+
+class PredictorRuntime:
+    """Warm-executable predictor for one model generation.
+
+    Immutable once built: hot swap creates a fresh runtime for the next
+    generation and atomically replaces the reference (registry.py), so
+    in-flight requests keep scoring against a consistent model.
+    """
+
+    def __init__(self, booster, *, num_iteration: int = -1,
+                 max_batch_rows: int = 4096, min_bucket_rows: int = 16,
+                 generation: int = 0):
+        import jax
+        import jax.numpy as jnp
+        from ..ops.predict import stack_trees
+
+        gbdt = booster._gbdt if hasattr(booster, "_gbdt") else booster
+        gbdt._flush_pending()
+        if not gbdt.models:
+            raise LightGBMError("cannot build a PredictorRuntime from a "
+                                "model with no trees")
+        if max_batch_rows < 1:
+            raise ValueError("max_batch_rows must be >= 1")
+        min_bucket_rows = max(1, min(min_bucket_rows, max_batch_rows))
+        self.generation = generation
+        self.max_batch_rows = int(max_batch_rows)
+        self.min_bucket_rows = int(min_bucket_rows)
+        self.objective = gbdt.objective
+        self.K = max(1, gbdt.K)
+        self.num_features = gbdt.max_feature_idx + 1
+        used = gbdt._num_used_models(num_iteration)
+        # one stacked-tree pytree per class; None for a class that never
+        # trained (its raw score stays 0, like GBDT._predict_raw_device)
+        self._stacks: List = []
+        self._depths: List[int] = []
+        for k in range(self.K):
+            trees = [gbdt.models[i] for i in range(used) if i % self.K == k]
+            if not trees:
+                self._stacks.append(None)
+                self._depths.append(1)
+                continue
+            stack = stack_trees(trees, binned=False)
+            self._stacks.append(jax.tree_util.tree_map(jax.device_put, stack))
+            self._depths.append(
+                max(max((t.max_depth_grown for t in trees), default=1), 1))
+        self._device_value = self._device_value_fn()
+        # X is donated only where donation is real; on CPU it would just
+        # print an "unusable donated buffer" warning per call
+        self._donate = jax.default_backend() in ("tpu", "gpu")
+        self._compiled: Dict[Tuple[int, str], object] = {}
+        self._lock = threading.Lock()
+        self.cache_hits = 0
+        self.cache_misses = 0
+
+    # -- compiled-program construction ---------------------------------
+
+    def _device_value_fn(self):
+        """Device-side raw→prediction transform for the "value" output
+        kind, or None when there is nothing to fuse: identity transforms
+        share the raw program (compiling a byte-identical twin per
+        bucket would double the cache for nothing), and objectives with
+        no known device form fall back to the host transform on the raw
+        program's result."""
+        import jax
+        from ..objectives import Objective
+
+        obj = self.objective
+        if obj is None or type(obj).convert_output is Objective.convert_output:
+            return None                                  # identity: use raw
+        name = getattr(obj, "name", "")
+        if name in ("binary", "multiclassova"):
+            sig = float(obj.sigmoid)
+            return lambda raw: jax.nn.sigmoid(sig * raw)
+        if name == "multiclass":
+            return lambda raw: jax.nn.softmax(raw, axis=0)
+        return None                                      # host fallback
+
+    def _run_kind(self, kind: str) -> str:
+        """The executable kind a request actually runs: "value" maps to
+        the raw program whenever no device transform is fused."""
+        return kind if kind == "raw" or self._device_value is not None \
+            else "raw"
+
+    def _build(self, bucket: int, kind: str):
+        """AOT-compile the walker for one (bucket, kind) — the only
+        place an XLA compilation can happen after the runtime is built."""
+        import jax
+        import jax.numpy as jnp
+        from ..ops.predict import ensemble_raw
+
+        depths = tuple(self._depths)
+        device_value = self._device_value if kind == "value" else None
+
+        def fn(stacks, X):
+            raw = ensemble_raw(stacks, X, depths=depths)   # [K, bucket]
+            if device_value is not None:
+                raw = device_value(raw)
+            return raw
+
+        donate = (1,) if self._donate else ()
+        t0 = time.perf_counter()
+        compiled = (jax.jit(fn, donate_argnums=donate)
+                    .lower(self._stacks,
+                           jax.ShapeDtypeStruct((bucket, self.num_features),
+                                                jnp.float32))
+                    .compile())
+        dt = time.perf_counter() - t0
+        profiling.add("serve/compile", dt, force=True)
+        profiling.count("serve.compile_seconds", dt)
+        return compiled
+
+    def _get_executable(self, bucket: int, kind: str):
+        key = (bucket, kind)
+        with self._lock:
+            exe = self._compiled.get(key)
+            if exe is not None:
+                self.cache_hits += 1
+                profiling.count("serve.cache_hit")
+                return exe
+        # compile outside the lock (minutes-long on big models); the
+        # double-build race just wastes one compile, never corrupts
+        exe = self._build(bucket, kind)
+        with self._lock:
+            winner = self._compiled.setdefault(key, exe)
+            self.cache_misses += 1
+            profiling.count("serve.cache_miss")
+        return winner
+
+    # -- introspection --------------------------------------------------
+
+    def buckets_compiled(self) -> List[Tuple[int, str]]:
+        with self._lock:
+            return sorted(self._compiled)
+
+    def warmup(self, buckets: Sequence[int] = (),
+               kinds: Sequence[str] = ("value",)) -> None:
+        """Compile + execute the given row buckets so the first real
+        request after a (re)load never pays compile latency.  Used by
+        ModelRegistry before a hot swap goes live."""
+        buckets = sorted({row_bucket(b, self.min_bucket_rows,
+                                     self.max_batch_rows)
+                          for b in (buckets or (1,))})
+        for b in buckets:
+            for kind in kinds:
+                zeros = np.zeros((b, self.num_features), np.float32)
+                self._run_compiled(b, self._run_kind(kind), zeros)
+
+    # -- prediction -----------------------------------------------------
+
+    def _run_compiled(self, bucket: int, kind: str, Xpad: np.ndarray):
+        import jax.numpy as jnp
+        exe = self._get_executable(bucket, kind)
+        out = exe(self._stacks, jnp.asarray(Xpad, jnp.float32))
+        return np.asarray(out, np.float64)               # [K, bucket]
+
+    def _predict_chunk(self, X: np.ndarray, kind: str) -> np.ndarray:
+        n = X.shape[0]
+        bucket = row_bucket(n, self.min_bucket_rows, self.max_batch_rows)
+        if n < bucket:
+            X = np.pad(X, ((0, bucket - n), (0, 0)))
+        return self._run_compiled(bucket, kind, X)[:, :n]
+
+    def predict(self, X: np.ndarray, kind: str = "value") -> np.ndarray:
+        """Score [n, F] rows; returns the same shapes as Booster.predict
+        ([n] for K==1, [n, K] otherwise).
+
+        Arbitrary n: full ``max_batch_rows`` slabs plus one bucketed
+        remainder, so every executed shape hits the warm cache — the
+        final partial chunk pads up instead of retracing.
+        """
+        if kind not in OUTPUT_KINDS:
+            raise ValueError(
+                f"unknown output kind {kind!r}; use one of {OUTPUT_KINDS} "
+                "(leaf indices go through Booster.predict(pred_leaf=True))")
+        X = np.ascontiguousarray(np.asarray(X, np.float64))
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        if X.shape[1] > self.num_features:
+            # wider input is legal (reference predictor semantics: extra
+            # trailing columns are ignored; the walk only gathers
+            # feature indices the model knows)
+            X = np.ascontiguousarray(X[:, :self.num_features])
+        elif X.shape[1] < self.num_features:
+            raise LightGBMError(
+                f"request has {X.shape[1]} features, model expects "
+                f"{self.num_features}")
+        n = X.shape[0]
+        if n == 0:
+            return (np.zeros(0) if self.K == 1
+                    else np.zeros((0, self.K)))
+        run_kind = self._run_kind(kind)
+        with profiling.phase("serve/execute", force=True):
+            parts = [self._predict_chunk(X[a:a + self.max_batch_rows],
+                                         run_kind)
+                     for a in range(0, n, self.max_batch_rows)]
+        raw = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=1)
+        out = raw[0] if self.K == 1 else raw.T
+        if kind == "value" and run_kind == "raw" and self.objective is not None:
+            out = self.objective.convert_output(out)
+        profiling.count("serve.rows", n)
+        return out

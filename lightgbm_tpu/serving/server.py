@@ -1,0 +1,269 @@
+"""Stdlib-only JSON-lines HTTP scoring endpoint.
+
+Endpoints:
+- ``POST /predict`` — body is JSON lines, one row per line: a JSON array
+  of feature values, or ``{"features": [...]}``.  A single JSON object
+  ``{"rows": [[...], ...]}`` is also accepted.  Response is JSON lines,
+  one prediction per input row (a number, or an array for multiclass).
+  ``?raw_score=1`` returns raw margins.
+- ``GET /healthz`` — liveness + active model generation.
+- ``GET /stats`` — request/row/batch counters, compiled-predictor cache
+  hits/misses, latency percentiles, queue depth, swap history, and the
+  profiling phase totals.
+
+Wired into the CLI as ``task=serve`` (application.py): requests flow
+HTTP handler → MicroBatcher → PredictorRuntime, with ModelRegistry
+hot-swapping generations underneath.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from .. import log, profiling
+from ..config import Config
+from ..log import LightGBMError
+from .batcher import MicroBatcher
+from .registry import ModelRegistry
+
+_REQUEST_TIMEOUT_S = 120.0
+
+
+def _parse_predict_body(body: bytes) -> np.ndarray:
+    text = body.decode("utf-8").strip()
+    if not text:
+        raise ValueError("empty request body")
+    obj = None
+    if text.startswith("{"):
+        try:                                 # whole-body object form,
+            obj = json.loads(text)           # pretty-printed or not
+        except json.JSONDecodeError:
+            obj = None                       # fall through to JSON lines
+    if obj is not None:
+        if "rows" in obj:
+            rows = obj["rows"]
+        elif "features" in obj:
+            rows = [obj["features"]]
+        else:
+            raise ValueError('object body needs "rows" or "features"')
+    else:
+        rows = []
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            item = json.loads(line)
+            rows.append(item["features"] if isinstance(item, dict) else item)
+    X = np.asarray(rows, dtype=np.float64)
+    if X.ndim != 2:
+        raise ValueError("rows must all have the same feature count")
+    return X
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "lightgbm-tpu-serve"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):       # route per-request chatter
+        log.debug(f"http {fmt % args}")      # away from stderr
+
+    def _respond(self, code: int, payload: bytes,
+                 content_type: str = "application/json") -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _respond_json(self, code: int, obj) -> None:
+        self._respond(code, (json.dumps(obj) + "\n").encode())
+
+    def do_GET(self):
+        srv: "PredictionServer" = self.server.prediction_server
+        path = self.path.split("?", 1)[0]
+        if path == "/healthz":
+            self._respond_json(200, {"status": "ok",
+                                     "generation": srv.registry.generation})
+        elif path == "/stats":
+            self._respond_json(200, srv.stats())
+        else:
+            self._respond_json(404, {"error": f"unknown path {path}"})
+
+    def do_POST(self):
+        srv: "PredictionServer" = self.server.prediction_server
+        # drain the body FIRST: keep-alive (HTTP/1.1) would otherwise
+        # parse leftover body bytes as the connection's next request
+        # line after an early 404/400
+        if "Content-Length" not in self.headers:
+            self.close_connection = True     # unknown body length
+            body = b""
+        else:
+            body = self.rfile.read(int(self.headers["Content-Length"]))
+        path, _, query = self.path.partition("?")
+        if path != "/predict":
+            self._respond_json(404, {"error": f"unknown path {path}"})
+            return
+        try:
+            from urllib.parse import parse_qs
+            X = _parse_predict_body(body)
+            qs = parse_qs(query)
+            raw = (qs["raw_score"][0] in ("1", "true")
+                   if "raw_score" in qs else srv.default_raw)
+            kind = "raw" if raw else "value"
+            fut = srv.batcher.submit(X, kind=kind)
+            preds = fut.result(timeout=_REQUEST_TIMEOUT_S)
+            # the generation that actually scored this batch (pinned by
+            # the flusher), not whatever is live at response time
+            generation = getattr(fut, "generation",
+                                 srv.registry.generation)
+        except (ValueError, KeyError, json.JSONDecodeError) as e:
+            self._respond_json(400, {"error": str(e)})
+            return
+        except LightGBMError as e:
+            self._respond_json(400, {"error": str(e)})
+            return
+        except Exception as e:               # scoring/internal failure
+            self._respond_json(500, {"error": str(e)})
+            return
+        lines = "".join(
+            json.dumps(p.tolist() if isinstance(p, np.ndarray) else float(p))
+            + "\n" for p in preds)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/jsonlines")
+        self.send_header("X-Model-Generation", str(generation))
+        out = lines.encode()
+        self.send_header("Content-Length", str(len(out)))
+        self.end_headers()
+        self.wfile.write(out)
+
+
+class PredictionServer:
+    """HTTP server + batcher + model-poll thread, with clean teardown
+    (context manager) so tests never leak a listener."""
+
+    def __init__(self, registry: ModelRegistry, *, host: str = "127.0.0.1",
+                 port: int = 0, max_batch_rows: int = 4096,
+                 flush_deadline_ms: float = 5.0,
+                 model_poll_seconds: float = 10.0,
+                 default_raw: bool = False):
+        self.registry = registry
+        self.default_raw = default_raw
+        self.model_poll_seconds = float(model_poll_seconds)
+        self.batcher = MicroBatcher(registry, max_batch_rows=max_batch_rows,
+                                    flush_deadline_ms=flush_deadline_ms)
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.prediction_server = self
+        self.host, self.port = self._httpd.server_address[:2]
+        self._stop = threading.Event()
+        self._threads = []
+
+    def stats(self) -> dict:
+        runtime = self.registry.current()
+        return {
+            "generation": self.registry.generation,
+            "model_path": self.registry.model_path,
+            "requests": profiling.counter_value("serve.requests"),
+            "rows": profiling.counter_value("serve.rows"),
+            "batches": profiling.counter_value("serve.batches"),
+            "queue_depth": self.batcher.queue_depth,
+            "cache_hits": profiling.counter_value("serve.cache_hit"),
+            "cache_misses": profiling.counter_value("serve.cache_miss"),
+            "compile_seconds": profiling.counter_value(
+                "serve.compile_seconds"),
+            "generation_cache": {
+                "hits": runtime.cache_hits,
+                "misses": runtime.cache_misses,
+                "buckets": [list(k) for k in runtime.buckets_compiled()],
+            },
+            "latency_ms": profiling.summary("serve.latency_ms"),
+            "queue_depth_seen": profiling.summary("serve.queue_depth"),
+            "swaps": self.registry.swaps,
+            "swap_failures": self.registry.swap_failures,
+            "phase_totals_s": {k: round(v, 6)
+                               for k, v in profiling.timings().items()
+                               if k.startswith("serve/")},
+        }
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> "PredictionServer":
+        t = threading.Thread(target=self._httpd.serve_forever,
+                             name="lgbt-serve-http", daemon=True)
+        t.start()
+        self._threads.append(t)
+        if self.model_poll_seconds > 0:
+            p = threading.Thread(target=self._poll_loop,
+                                 name="lgbt-serve-poll", daemon=True)
+            p.start()
+            self._threads.append(p)
+        log.info(f"serving on http://{self.host}:{self.port} "
+                 f"(generation {self.registry.generation})")
+        return self
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self.model_poll_seconds):
+            try:
+                self.registry.poll_once()
+            except Exception as e:           # never kill the poll loop
+                log.warning(f"model poll failed: {e}")
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self.batcher.close()
+        for t in self._threads:
+            t.join(timeout=10)
+
+    def __enter__(self) -> "PredictionServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def server_from_config(cfg: Config) -> PredictionServer:
+    """Build (not start) a PredictionServer from CLI/config parameters."""
+    if not cfg.input_model:
+        raise LightGBMError("task=serve needs a model: set input_model=<file>")
+    registry = ModelRegistry(
+        cfg.input_model, params={"verbose": cfg.verbose},
+        num_iteration=cfg.num_iteration_predict,
+        max_batch_rows=cfg.max_batch_rows,
+        min_bucket_rows=cfg.min_bucket_rows,
+        # warm the kind this server's default traffic will actually hit
+        warmup_kinds=("raw",) if cfg.is_predict_raw_score else ("value",))
+    return PredictionServer(
+        registry, host=cfg.serve_host, port=cfg.serve_port,
+        max_batch_rows=cfg.max_batch_rows,
+        flush_deadline_ms=cfg.flush_deadline_ms,
+        model_poll_seconds=cfg.model_poll_seconds,
+        default_raw=cfg.is_predict_raw_score)
+
+
+def serve_from_config(cfg: Config) -> None:
+    """Blocking ``task=serve`` entry: serve until SIGINT/SIGTERM."""
+    import signal
+
+    server = server_from_config(cfg)
+    server.registry.install_sighup()
+    done = threading.Event()
+
+    def _on_term(_signum, _frame):
+        done.set()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+    except (ValueError, OSError):
+        pass
+    with server:
+        try:
+            done.wait()
+        except KeyboardInterrupt:
+            pass
+    log.info("serving stopped")

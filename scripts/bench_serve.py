@@ -1,0 +1,135 @@
+"""Served-latency probe — prints ONE JSON line (same shape as bench.py).
+
+Spins up the full serving stack (ModelRegistry → MicroBatcher →
+PredictorRuntime → HTTP) on the CPU backend against a synthetic
+HIGGS-shaped binary model, fires concurrent /predict requests from
+client threads, and reports p50/p95 request latency and sustained
+rows/s.  Every future perf PR gets a served-latency surface to measure
+against, not just train seconds/iter.
+
+Env knobs: SERVE_BENCH_ROWS (rows per request, default 64),
+SERVE_BENCH_CLIENTS (default 8), SERVE_BENCH_REQUESTS (total, default
+400), SERVE_BENCH_TREES (default 50).
+"""
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+ROWS_PER_REQ = int(os.environ.get("SERVE_BENCH_ROWS", 64))
+CLIENTS = int(os.environ.get("SERVE_BENCH_CLIENTS", 8))
+REQUESTS = int(os.environ.get("SERVE_BENCH_REQUESTS", 400))
+TREES = int(os.environ.get("SERVE_BENCH_TREES", 50))
+FEATURES = 28
+
+
+def main() -> None:
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import profiling
+    from lightgbm_tpu.serving import ModelRegistry, PredictionServer
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(20_000, FEATURES)
+    z = X @ rng.randn(FEATURES)
+    y = (z > np.median(z)).astype(float)
+    bst = lgb.Booster({"objective": "binary", "verbose": -1,
+                       "num_leaves": 63, "min_data_in_leaf": 20},
+                      lgb.Dataset(X, y))
+    for _ in range(TREES):
+        bst.update()
+
+    with tempfile.TemporaryDirectory() as tmp:
+        model_path = os.path.join(tmp, "model.txt")
+        bst.save_model(model_path)
+        # warm every bucket a coalesced batch can land on (1 request up
+        # to all clients' requests in one flush)
+        warm = []
+        b = ROWS_PER_REQ
+        while b <= min(CLIENTS * ROWS_PER_REQ, 4096):
+            warm.append(b)
+            b <<= 1
+        registry = ModelRegistry(model_path, params={"verbose": -1},
+                                 max_batch_rows=4096,
+                                 warmup_buckets=tuple(warm) or (ROWS_PER_REQ,))
+        server = PredictionServer(registry, flush_deadline_ms=2.0,
+                                  model_poll_seconds=0)
+        latencies = []
+        lat_lock = threading.Lock()
+        errors = []
+
+        def client(n_requests: int) -> None:
+            import http.client
+            conn = http.client.HTTPConnection(server.host, server.port,
+                                              timeout=120)
+            try:
+                for i in range(n_requests):
+                    rows = X[(i * ROWS_PER_REQ) % 10_000:][:ROWS_PER_REQ]
+                    body = "\n".join(
+                        json.dumps([float(v) for v in r]) for r in rows)
+                    t0 = time.perf_counter()
+                    conn.request("POST", "/predict", body)
+                    resp = conn.getresponse()
+                    resp.read()
+                    dt = time.perf_counter() - t0
+                    if resp.status != 200:
+                        errors.append(resp.status)
+                        return
+                    with lat_lock:
+                        latencies.append(dt)
+            except Exception as e:
+                errors.append(repr(e))
+            finally:
+                conn.close()
+
+        with server:
+            # warmup: populate the executable cache before timing
+            client(3)
+            with lat_lock:
+                latencies.clear()
+            misses_before = profiling.counter_value("serve.cache_miss")
+            per_client = max(1, REQUESTS // CLIENTS)
+            threads = [threading.Thread(target=client, args=(per_client,))
+                       for _ in range(CLIENTS)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            misses_after = profiling.counter_value("serve.cache_miss")
+            stats = server.stats()
+
+    lat = sorted(latencies)
+    if errors or not lat:
+        print(json.dumps({"metric": "serve latency", "value": None,
+                          "unit": "ms", "error": str(errors[:3])}))
+        return
+
+    def q(p: float) -> float:
+        return lat[min(len(lat) - 1, int(p * len(lat)))]
+
+    print(json.dumps({
+        "metric": f"serve synthetic {FEATURES}f {TREES} trees, "
+                  f"{ROWS_PER_REQ} rows/req x {CLIENTS} clients: "
+                  f"p50 request latency",
+        "value": round(q(0.50) * 1e3, 3),
+        "unit": "ms",
+        "p95_ms": round(q(0.95) * 1e3, 3),
+        "rows_per_s": round(len(lat) * ROWS_PER_REQ / wall, 1),
+        "requests": len(lat),
+        "warm_cache_misses": misses_after - misses_before,
+        "batches": stats["batches"],
+        "generation": stats["generation"],
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -30,7 +30,10 @@ def test_train_predict_cycle(tmp_path, binary_example):
     preds = np.loadtxt(out)
     X, y, Xt, yt = binary_example
     bst = lgb.Booster(model_file=str(model))
-    np.testing.assert_allclose(preds, bst.predict(Xt), rtol=1e-14)
+    # CLI predict scores through the serving PredictorRuntime's f32
+    # device walk (shared compile cache with task=serve); the in-memory
+    # Booster.predict reference uses the host f64 walk for small batches
+    np.testing.assert_allclose(preds, bst.predict(Xt), atol=1e-6)
     # weighted training actually used the .weight side file
     assert preds.shape[0] == len(yt)
 
@@ -76,7 +79,7 @@ def test_regression_example_conf(tmp_path):
 
 def test_predict_file_streaming_chunks_match_oneshot(tmp_path, binary_example):
     """Chunked predict_file (predictor.hpp:80-159 pipelined-reader analog)
-    must produce byte-identical output to a whole-file pass."""
+    must match a whole-file pass to float32-walk precision."""
     X, y, Xt, yt = binary_example
     bst = lgb.Booster({"objective": "binary", "verbose": -1,
                        "num_leaves": 15}, lgb.Dataset(X, y))
@@ -91,6 +94,10 @@ def test_predict_file_streaming_chunks_match_oneshot(tmp_path, binary_example):
     out_big = tmp_path / "preds_big.txt"
     p.predict_file(str(data), str(out_small), chunk_rows=37)
     p.predict_file(str(data), str(out_big), chunk_rows=1 << 20)
-    assert out_small.read_text() == out_big.read_text()
+    # both pass through the runtime's padded row buckets; tiny f32
+    # reduction-order drift across bucket shapes is permitted, but the
+    # host-walk reference must agree to serving tolerance (1e-6)
+    np.testing.assert_allclose(np.loadtxt(out_small),
+                               np.loadtxt(out_big), atol=1e-7)
     np.testing.assert_allclose(np.loadtxt(out_small), bst.predict(Xt),
-                               rtol=1e-14)
+                               atol=1e-6)

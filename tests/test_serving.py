@@ -1,0 +1,418 @@
+"""Serving subsystem tests: compiled-predictor cache, micro-batching,
+model hot-swap, and the JSON-lines HTTP endpoint.
+
+All tier-1 (not slow), synthetic data only, and every server/batcher is
+torn down in a finally/context-manager so no listener or thread outlives
+a failing test.
+"""
+import json
+import http.client
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import profiling
+from lightgbm_tpu.serving import (MicroBatcher, ModelRegistry,
+                                  PredictionServer, PredictorRuntime,
+                                  row_bucket)
+
+pytestmark = pytest.mark.quick
+
+
+def _train_binary(num_leaves=15, rounds=5, seed=7):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(400, 10)
+    w = rng.randn(10)
+    z = X @ w
+    y = (z > np.median(z)).astype(float)
+    bst = lgb.Booster({"objective": "binary", "verbose": -1,
+                       "num_leaves": num_leaves, "min_data_in_leaf": 5},
+                      lgb.Dataset(X, y))
+    for _ in range(rounds):
+        bst.update()
+    assert bst.num_trees() > 0
+    return bst, X
+
+
+@pytest.fixture(scope="module")
+def binary_model():
+    return _train_binary()
+
+
+def _post_predict(host, port, X, path="/predict"):
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    try:
+        body = "\n".join(json.dumps([float(v) for v in row]) for row in X)
+        conn.request("POST", path, body)
+        r = conn.getresponse()
+        text = r.read().decode()
+        if r.status != 200:
+            raise AssertionError(f"HTTP {r.status}: {text}")
+        gen = int(r.getheader("X-Model-Generation"))
+        preds = np.array([json.loads(l) for l in text.strip().splitlines()])
+        return preds, gen
+    finally:
+        conn.close()
+
+
+def _get_json(host, port, path):
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    try:
+        conn.request("GET", path)
+        r = conn.getresponse()
+        assert r.status == 200
+        return json.loads(r.read())
+    finally:
+        conn.close()
+
+
+# -- runtime ------------------------------------------------------------
+
+
+def test_row_bucket():
+    assert row_bucket(1, 16, 4096) == 16
+    assert row_bucket(16, 16, 4096) == 16
+    assert row_bucket(17, 16, 4096) == 32
+    assert row_bucket(4096, 16, 4096) == 4096
+    assert row_bucket(9999, 16, 4096) == 4096  # caller splits above cap
+
+
+def test_runtime_parity_and_warm_cache(binary_model):
+    bst, X = binary_model
+    rt = PredictorRuntime(bst, max_batch_rows=256, min_bucket_rows=16)
+    for n in (1, 3, 16, 37, 300):
+        got = rt.predict(X[:n])
+        ref = bst.predict(X[:n])
+        assert got.shape == ref.shape
+        np.testing.assert_allclose(got, ref, atol=1e-6)
+    # buckets seen: 16 (n=1,3,16), 64 (n=37 and the 300-row remainder),
+    # 256 (n=300 slab) — all value-kind
+    assert rt.buckets_compiled() == [(16, "value"), (64, "value"),
+                                     (256, "value")]
+    # warm cache: repeating every shape triggers ZERO new compilations
+    misses = rt.cache_misses
+    for n in (1, 3, 16, 37, 300):
+        rt.predict(X[:n])
+    assert rt.cache_misses == misses
+    # raw kind is a distinct cache entry and matches raw_score=True
+    np.testing.assert_allclose(rt.predict(X[:5], kind="raw"),
+                               bst.predict(X[:5], raw_score=True),
+                               atol=1e-6)
+    assert (16, "raw") in rt.buckets_compiled()
+
+
+def test_runtime_padding_never_leaks(binary_model):
+    bst, X = binary_model
+    rt = PredictorRuntime(bst, max_batch_rows=256, min_bucket_rows=16)
+    # 37 rows pad to bucket 64: output length is 37 and each row equals
+    # its single-row prediction (padding rows influence nothing)
+    got = rt.predict(X[:37])
+    assert got.shape == (37,)
+    for i in (0, 17, 36):
+        np.testing.assert_allclose(got[i], rt.predict(X[i:i + 1])[0],
+                                   atol=1e-9)
+    # adversarial: trailing garbage rows in the same bucket don't bleed
+    Xg = np.vstack([X[:37], np.full((5, X.shape[1]), 1e30)])
+    np.testing.assert_allclose(rt.predict(Xg)[:37], got, atol=1e-9)
+
+
+def test_runtime_multiclass_parity():
+    rng = np.random.RandomState(11)
+    X = rng.rand(300, 6)
+    y = (X[:, 0] * 3 + X[:, 1]).astype(int) % 3
+    bst = lgb.Booster({"objective": "multiclass", "num_class": 3,
+                       "verbose": -1, "num_leaves": 7,
+                       "min_data_in_leaf": 5}, lgb.Dataset(X, y))
+    for _ in range(3):
+        bst.update()
+    rt = PredictorRuntime(bst, max_batch_rows=512)
+    for n in (1, 33, 300):
+        got = rt.predict(X[:n])
+        ref = bst.predict(X[:n])
+        assert got.shape == ref.shape == (n, 3)
+        np.testing.assert_allclose(got, ref, atol=1e-6)
+
+
+def test_runtime_identity_objective_shares_raw_program():
+    """Regression objective: "value" output IS the raw score, so both
+    kinds must share one executable per bucket (no twin compiles)."""
+    rng = np.random.RandomState(3)
+    X = rng.rand(200, 5)
+    y = X @ rng.randn(5)
+    bst = lgb.Booster({"objective": "regression", "verbose": -1,
+                       "num_leaves": 7, "min_data_in_leaf": 5},
+                      lgb.Dataset(X, y))
+    for _ in range(3):
+        bst.update()
+    rt = PredictorRuntime(bst, max_batch_rows=64)
+    np.testing.assert_allclose(rt.predict(X[:10]), bst.predict(X[:10]),
+                               atol=1e-6)
+    np.testing.assert_allclose(rt.predict(X[:10], kind="raw"),
+                               bst.predict(X[:10], raw_score=True),
+                               atol=1e-6)
+    assert rt.buckets_compiled() == [(16, "raw")]
+    assert rt.cache_misses == 1
+
+
+def test_runtime_rejects_bad_input(binary_model):
+    bst, X = binary_model
+    rt = PredictorRuntime(bst, max_batch_rows=64)
+    with pytest.raises(lgb.LightGBMError):
+        rt.predict(np.zeros((3, 2)))         # too few features
+    with pytest.raises(ValueError):
+        rt.predict(X[:2], kind="leaf")       # unsupported kind
+    assert rt.predict(np.zeros((0, X.shape[1]))).shape == (0,)
+    # wider input is legal: extra trailing columns are ignored
+    Xw = np.hstack([X[:4], np.full((4, 3), 1e30)])
+    np.testing.assert_allclose(rt.predict(Xw), rt.predict(X[:4]),
+                               atol=1e-9)
+
+
+# -- CLI predictor shares the runtime path -------------------------------
+
+
+def test_predict_file_bucketed_chunks_match_oneshot(tmp_path, binary_model):
+    from lightgbm_tpu.application import Predictor
+    bst, X = binary_model
+    data = tmp_path / "pred.csv"
+    rows = [",".join(["0"] + [f"{v:.17g}" for v in row]) for row in X]
+    data.write_text("\n".join(rows) + "\n")
+    p = Predictor(bst)
+    out_small = tmp_path / "small.txt"
+    out_big = tmp_path / "big.txt"
+    # 37-row chunks: final partial chunk pads to its bucket, no retrace
+    p.predict_file(str(data), str(out_small), chunk_rows=37)
+    p.predict_file(str(data), str(out_big), chunk_rows=1 << 20)
+    np.testing.assert_allclose(np.loadtxt(out_small), np.loadtxt(out_big),
+                               atol=1e-7)
+    np.testing.assert_allclose(np.loadtxt(out_small), bst.predict(X),
+                               atol=1e-6)
+
+
+# -- micro-batcher -------------------------------------------------------
+
+
+def test_batcher_deadline_flush(binary_model):
+    bst, X = binary_model
+    rt = PredictorRuntime(bst, max_batch_rows=1024)
+    mb = MicroBatcher(rt, max_batch_rows=1024, flush_deadline_ms=20)
+    try:
+        # a lone small request cannot fill the batch: the deadline must
+        # flush it
+        t0 = time.perf_counter()
+        preds = mb.submit(X[:3]).result(timeout=30)
+        waited = time.perf_counter() - t0
+        np.testing.assert_allclose(preds, bst.predict(X[:3]), atol=1e-6)
+        assert waited < 25           # deadline (20 ms) + slack, not 30 s
+    finally:
+        mb.close()
+
+
+def test_batcher_concurrent_coalescing(binary_model):
+    bst, X = binary_model
+    rt = PredictorRuntime(bst, max_batch_rows=64, min_bucket_rows=16)
+    mb = MicroBatcher(rt, max_batch_rows=64, flush_deadline_ms=30)
+    ref = bst.predict(X)
+    errs = []
+
+    def client(lo, hi):
+        try:
+            got = mb.submit(X[lo:hi]).result(timeout=60)
+            np.testing.assert_allclose(got, ref[lo:hi], atol=1e-6)
+        except Exception as e:       # surface in the main thread
+            errs.append(e)
+
+    try:
+        threads = [threading.Thread(target=client, args=(i * 8, i * 8 + 8))
+                   for i in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errs
+        # coalescing happened: fewer flushes than requests
+        assert 1 <= mb.batches_flushed <= 12
+    finally:
+        mb.close()
+
+
+def test_batcher_isolates_malformed_request(binary_model):
+    bst, X = binary_model
+    rt = PredictorRuntime(bst, max_batch_rows=256)
+    mb = MicroBatcher(rt, max_batch_rows=256, flush_deadline_ms=50)
+    try:
+        good = mb.submit(X[:4])
+        bad = mb.submit(np.zeros((2, 3)))    # too narrow, same batch
+        np.testing.assert_allclose(good.result(timeout=30),
+                                   bst.predict(X[:4]), atol=1e-6)
+        with pytest.raises(lgb.LightGBMError):
+            bad.result(timeout=30)
+    finally:
+        mb.close()
+
+
+# -- registry / hot swap -------------------------------------------------
+
+
+def _save(bst, path):
+    tmp = path + ".tmp"
+    bst.save_model(tmp)
+    os.replace(tmp, path)            # atomic publish, like production
+
+
+def test_hot_swap_and_rollback(tmp_path, binary_model):
+    bst_a, X = binary_model
+    bst_b, _ = _train_binary(num_leaves=31, rounds=10, seed=13)
+    preds_a = bst_a.predict(X[:32])
+    preds_b = bst_b.predict(X[:32])
+    assert np.abs(preds_a - preds_b).max() > 1e-4   # distinguishable
+    path = str(tmp_path / "model.txt")
+    _save(bst_a, path)
+    reg = ModelRegistry(path, params={"verbose": -1}, max_batch_rows=256)
+    assert reg.generation == 1
+    mb = MicroBatcher(reg, max_batch_rows=256, flush_deadline_ms=1)
+    stop = threading.Event()
+    violations = []
+
+    def hammer():
+        while not stop.is_set():
+            got = mb.submit(X[:32]).result(timeout=60)
+            ok_a = np.allclose(got, preds_a, atol=1e-6)
+            ok_b = np.allclose(got, preds_b, atol=1e-6)
+            if not (ok_a or ok_b):   # a half-swapped model would land here
+                violations.append(got)
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    try:
+        for t in threads:
+            t.start()
+        # swap under load
+        time.sleep(0.05)
+        _save(bst_b, path)
+        assert reg.maybe_reload() is True
+        assert reg.generation == 2
+        time.sleep(0.05)
+        # rollback: a corrupt model must not take down serving
+        with open(path, "w") as f:
+            f.write("this is not a model\n")
+        assert reg.maybe_reload() is False
+        assert reg.generation == 2
+        assert reg.swap_failures == 1
+        # the bad signature is remembered — no retry-spin
+        assert reg.maybe_reload() is False
+        time.sleep(0.05)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        mb.close()
+    assert not violations
+    # post-rollback generation still serves model B
+    got = reg.current().predict(X[:32])
+    np.testing.assert_allclose(got, preds_b, atol=1e-6)
+
+
+def test_swap_warms_previous_buckets(tmp_path, binary_model):
+    bst, X = binary_model
+    path = str(tmp_path / "model.txt")
+    _save(bst, path)
+    reg = ModelRegistry(path, params={"verbose": -1}, max_batch_rows=256)
+    reg.current().predict(X[:37])    # compile buckets 16 (warmup) and 64
+    old_buckets = reg.current().buckets_compiled()
+    _save(bst, path)                 # same model, new mtime
+    assert reg.maybe_reload() is True
+    new_rt = reg.current()
+    assert new_rt.buckets_compiled() == old_buckets
+    # first post-swap request in a warmed bucket: zero new compiles
+    misses = new_rt.cache_misses
+    new_rt.predict(X[:37])
+    assert new_rt.cache_misses == misses
+
+
+# -- HTTP server ---------------------------------------------------------
+
+
+def test_server_end_to_end_and_zero_recompile_stats(tmp_path, binary_model):
+    bst, X = binary_model
+    path = str(tmp_path / "model.txt")
+    _save(bst, path)
+    reg = ModelRegistry(path, params={"verbose": -1}, max_batch_rows=256)
+    with PredictionServer(reg, flush_deadline_ms=2,
+                          model_poll_seconds=0) as srv:
+        health = _get_json(srv.host, srv.port, "/healthz")
+        assert health["status"] == "ok" and health["generation"] == 1
+        preds, gen = _post_predict(srv.host, srv.port, X[:20])
+        assert gen == 1
+        np.testing.assert_allclose(preds, bst.predict(X[:20]), atol=1e-6)
+        # acceptance: after warmup, repeated same-bucket requests against
+        # the same generation trigger ZERO new XLA compilations, visible
+        # through the cache-miss counter at /stats
+        before = _get_json(srv.host, srv.port, "/stats")
+        for _ in range(10):
+            _post_predict(srv.host, srv.port, X[:20])
+        after = _get_json(srv.host, srv.port, "/stats")
+        assert after["cache_misses"] == before["cache_misses"]
+        assert after["cache_hits"] >= before["cache_hits"] + 10
+        assert after["requests"] >= before["requests"] + 10
+        assert after["generation"] == 1
+        assert after["latency_ms"]["count"] > 0
+        # malformed request: 400, not a dead server
+        conn = http.client.HTTPConnection(srv.host, srv.port, timeout=30)
+        try:
+            conn.request("POST", "/predict", "not json")
+            assert conn.getresponse().status == 400
+        finally:
+            conn.close()
+        _post_predict(srv.host, srv.port, X[:5])   # still serving
+    # listener is gone after the context exits
+    with pytest.raises(OSError):
+        c = http.client.HTTPConnection(srv.host, srv.port, timeout=2)
+        try:
+            c.request("GET", "/healthz")
+            c.getresponse()
+        finally:
+            c.close()
+
+
+def test_serve_config_keys_and_aliases():
+    from lightgbm_tpu.config import config_from_params
+    cfg = config_from_params({"task": "serve", "serving_port": 1234,
+                              "batch_rows": 512, "flush_deadline": 7,
+                              "model_poll": 3})
+    assert cfg.serve_port == 1234
+    assert cfg.max_batch_rows == 512
+    assert cfg.flush_deadline_ms == 7.0
+    assert cfg.model_poll_seconds == 3.0
+    with pytest.raises(ValueError):
+        config_from_params({"serve_port": 99999})
+    with pytest.raises(ValueError):
+        config_from_params({"max_batch_rows": 0})
+
+
+def test_serve_task_requires_model():
+    from lightgbm_tpu.application import main
+    assert main(["task=serve"]) == 1     # no input_model -> clean error
+
+
+def test_predictor_zero_tree_model_falls_back_to_host(tmp_path):
+    """A valid 0-tree model must still batch-predict (baseline scores),
+    via the host path — the runtime has nothing to compile."""
+    from lightgbm_tpu.application import Predictor
+    rng = np.random.RandomState(5)
+    X = rng.rand(50, 4)
+    y = rng.rand(50)
+    bst = lgb.Booster({"objective": "regression", "verbose": -1,
+                       "boost_from_average": False}, lgb.Dataset(X, y))
+    assert bst.num_trees() == 0
+    p = Predictor(bst)
+    assert p.runtime is None
+    out = tmp_path / "preds.txt"
+    data = tmp_path / "zero.csv"
+    data.write_text("\n".join(
+        ",".join(["0"] + [f"{v:g}" for v in row]) for row in X) + "\n")
+    p.predict_file(str(data), str(out))
+    np.testing.assert_allclose(np.loadtxt(out), bst.predict(X))
