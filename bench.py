@@ -60,6 +60,10 @@ HIST_DTYPE = os.environ.get("BENCH_HIST_DTYPE", "int8")
 # 255 is the tracked north-star config; 63 is the reference accelerator
 # sweet spot (docs/GPU-Performance.md:153-156) measured as a variant
 BINS = int(os.environ.get("BENCH_BINS", 255))
+# "higgs" (tracked) or "onehot" (EFB acceptance shape: 240 one-hot
+# columns, 100% exclusive; A/B with BENCH_ENABLE_BUNDLE=0/1)
+WORKLOAD = os.environ.get("BENCH_WORKLOAD", "higgs")
+ENABLE_BUNDLE = os.environ.get("BENCH_ENABLE_BUNDLE", "1") != "0"
 
 
 def binned_dataset(tag, X, y, params, categorical_feature="auto",
@@ -132,6 +136,20 @@ def synth_higgs(n, f=28, seed=42):
     return X.astype(np.float64), y
 
 
+def synth_onehot(n, groups=40, card=6, seed=42):
+    """One-hot-heavy EFB acceptance shape (BENCH_WORKLOAD=onehot):
+    groups*card columns, exactly one non-zero per group per row — 100%
+    exclusive, so bundling shrinks the histogrammed width to ~groups."""
+    w = np.random.RandomState(0).randn(groups * card)
+    rng = np.random.RandomState(seed)
+    codes = rng.randint(0, card, size=(n, groups))
+    X = np.zeros((n, groups * card), np.float64)
+    for g in range(groups):
+        X[np.arange(n), g * card + codes[:, g]] = 1.0
+    y = (X @ w + rng.logistic(size=n) * 0.5 > 0).astype(np.float64)
+    return X, y
+
+
 def main():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     global ROWS, ITERS
@@ -147,17 +165,22 @@ def main():
                 "tracked metric")
     import lightgbm_tpu as lgb
 
-    X, y = synth_higgs(ROWS)
+    if WORKLOAD == "onehot":
+        X, y = synth_onehot(ROWS)
+    else:
+        X, y = synth_higgs(ROWS)
     params = {
         "objective": "binary", "metric": "auc", "verbose": -1,
         "num_leaves": LEAVES, "learning_rate": 0.1, "max_bin": BINS,
         "min_data_in_leaf": 1, "min_sum_hessian_in_leaf": 100.0,
+        "enable_bundle": ENABLE_BUNDLE,
         # bf16 histogram operands: validated at AUC parity with f32 on
         # this workload (the reference GPU path makes the same
         # single-precision trade, docs/GPU-Performance.md:130-134)
         "histogram_dtype": HIST_DTYPE,
     }
-    train = binned_dataset("higgs", X, y, params)
+    cache_tag = WORKLOAD if ENABLE_BUNDLE else f"{WORKLOAD}_nobundle"
+    train = binned_dataset(cache_tag, X, y, params)
     bst = lgb.Booster(params, train)
     narrow_fallback = False
     try:
@@ -197,12 +220,12 @@ def main():
     # provenance.  Steady-state s/iter is the fair comparison: this bench
     # window is also post-compile steady state.
     tracked = os.path.join(root, "baseline_measured.json")
-    if (ROWS == 10_500_000 and LEAVES == 255 and BINS == 255
-            and os.path.exists(tracked)):
+    if (WORKLOAD == "higgs" and ROWS == 10_500_000 and LEAVES == 255
+            and BINS == 255 and os.path.exists(tracked)):
         ref = json.load(open(tracked)).get("measured", {})
         if ref.get("ref_seconds_per_iter_steady_state"):
             vs = ref["ref_seconds_per_iter_steady_state"] / s_per_iter
-    if vs == 0.0 and BINS == 255:
+    if vs == 0.0 and BINS == 255 and WORKLOAD == "higgs":
         # the ad-hoc baseline is a 255-bin run (make_baseline.py); a
         # 63-bin variant must not claim a speedup against it
         base_file = os.path.join(root, ".bench", "baseline.json")
@@ -217,9 +240,21 @@ def main():
     from lightgbm_tpu.ops import histogram as _h
     from lightgbm_tpu.ops import partition as _p
     from lightgbm_tpu.learner.common import padded_bin_count as _padded_bin_count
+    # bundling stats: what the histogram kernel actually saw (effective
+    # column count + realized conflict rate) — the perf trajectory must
+    # distinguish an EFB-compacted run from a full-width one
+    inner = train._inner
+    plan = inner.bundle_plan
+    bundling = {
+        "enable_bundle": bool(getattr(inner.config, "enable_bundle", False)),
+        "features": int(inner.num_features),
+        "effective_features": int(inner.num_store_columns),
+        "bundles": 0 if plan is None else plan.num_bundles,
+        "realized_conflict_rate": round(inner.realized_conflict_rate(), 6),
+    }
     out = {
-        "metric": f"synthetic-higgs {ROWS}x28 gbdt {LEAVES} leaves, "
-                  f"{BINS} bins: train seconds/iter",
+        "metric": f"synthetic-{WORKLOAD} {ROWS}x{X.shape[1]} gbdt "
+                  f"{LEAVES} leaves, {BINS} bins: train seconds/iter",
         "value": round(s_per_iter, 4),
         "unit": "s/iter",
         "vs_baseline": round(vs, 4),
@@ -235,6 +270,7 @@ def main():
             "hist_dtype": HIST_DTYPE,
             "narrow_compile_fallback": narrow_fallback,
         },
+        "bundling": bundling,
     }
     if note:
         out["note"] = note

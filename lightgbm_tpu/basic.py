@@ -19,12 +19,36 @@ from .boosting.gbdt import GBDT, create_boosting
 from .log import LightGBMError  # noqa: F401  (canonical error type)
 
 
+_sparse_densify_warned = False
+
+
+def _warn_sparse_densify(shape) -> None:
+    """One-time warning when a scipy-sparse matrix is materialized dense
+    (training avoids this via Dataset.from_csc; prediction still
+    densifies row chunks)."""
+    global _sparse_densify_warned
+    if _sparse_densify_warned:
+        return
+    _sparse_densify_warned = True
+    from . import log
+    est = shape[0] * shape[1] * 8
+    log.warning(
+        f"densifying a scipy sparse matrix of shape {tuple(shape)} "
+        f"(~{est / 1e6:.1f} MB as float64); pass training data as-is to "
+        "Dataset so the binner streams CSC columns instead")
+
+
+def _is_scipy_sparse(data) -> bool:
+    return hasattr(data, "toarray") and hasattr(data, "tocsc")
+
+
 def _to_numpy(data) -> np.ndarray:
     if hasattr(data, "values"):  # pandas DataFrame/Series
         return np.asarray(data.values, dtype=np.float64)
     if isinstance(data, (list, tuple)):
         return np.asarray(data, dtype=np.float64)
-    if hasattr(data, "toarray"):  # scipy sparse
+    if _is_scipy_sparse(data):
+        _warn_sparse_densify(data.shape)
         return np.asarray(data.toarray(), dtype=np.float64)
     return np.asarray(data, dtype=np.float64)
 
@@ -162,9 +186,6 @@ class Dataset:
         else:
             data, cat_cols, self.pandas_categorical = _resolve_categorical(
                 self.data, self.categorical_feature, self.feature_name)
-            X = _to_numpy(data)
-            if X.ndim == 1:
-                X = X.reshape(-1, 1)
             y = None if self.label is None else _to_numpy(self.label).reshape(-1)
             md = Metadata()
             if self.weight is not None:
@@ -181,6 +202,18 @@ class Dataset:
                 names = [str(c) for c in self.data.columns]
             ref_inner = (self.reference.construct()._inner
                          if self.reference is not None else None)
+            if _is_scipy_sparse(data):
+                # stream CSC columns into the binner — the full dense
+                # matrix never materializes (one-time warning covers the
+                # remaining densifying call sites, e.g. predict)
+                self._inner = _InnerDataset.from_csc(
+                    data, y, cfg, metadata=md, feature_names=names,
+                    categorical_feature=cat_cols, reference=ref_inner)
+                self._raw_X = data if not self.free_raw_data else None
+                return self
+            X = _to_numpy(data)
+            if X.ndim == 1:
+                X = X.reshape(-1, 1)
             self._inner = _InnerDataset(
                 X, y, cfg, reference=ref_inner, metadata=md,
                 feature_names=names, categorical_feature=cat_cols)

@@ -276,6 +276,205 @@ def find_bin(sample_values: np.ndarray, total_sample_cnt: int, max_bin: int,
     return m
 
 
+# ----------------------------------------------------------------------------
+# Exclusive Feature Bundling (EFB)
+#
+# The reference packs mutually-exclusive sparse features into shared
+# FeatureGroups (src/io/dataset.cpp FindGroups/FastFeatureBundling); the
+# sparse-GPU boosting literature (arXiv:1706.08359, arXiv:1806.11248) shows
+# compacting exclusive columns is where dense-histogram accelerators win.
+# Here a bundle is ONE stored column: bin 0 means "every member at its
+# default bin", and member f's non-default bins occupy the slot range
+# [offset_f, offset_f + num_bin_f - 1).  Slot packing removes the default
+# bin from the middle of the range but keeps the bin ORDER, so a numerical
+# threshold maps to one contiguous slot interval (ops/split.py
+# bundle_predicate_params) and histograms unbundle by gather + a
+# total-minus-sum reconstruction of the default bin.
+# ----------------------------------------------------------------------------
+
+@dataclass
+class BundlePlan:
+    """Static description of how used features map onto stored columns.
+
+    All per-feature arrays are indexed by the INNER (used-feature) index.
+    """
+    feat_col: np.ndarray      # [F] int32 stored column holding feature k
+    feat_offset: np.ndarray   # [F] int32 first slot of k (0 if not packed)
+    feat_default: np.ndarray  # [F] int32 default bin of k
+    feat_nslots: np.ndarray   # [F] int32 non-default slot count (nb - 1)
+    feat_packed: np.ndarray   # [F] bool  k shares its column
+    col_num_bins: np.ndarray  # [C] int32 bins per stored column
+    est_conflict_rate: float = 0.0   # sampled estimate used by the planner
+    sample_rows: int = 0
+
+    @property
+    def num_columns(self) -> int:
+        return int(self.col_num_bins.shape[0])
+
+    @property
+    def num_features(self) -> int:
+        return int(self.feat_col.shape[0])
+
+    @property
+    def num_packed(self) -> int:
+        return int(self.feat_packed.sum())
+
+    @property
+    def num_bundles(self) -> int:
+        """Multi-feature bundles (columns holding >= 2 features)."""
+        return int(len(set(self.feat_col[self.feat_packed])))
+
+    def feat_table(self) -> np.ndarray:
+        """[5, F] float32 (col, offset, default, nslots, packed) — the
+        device lookup table ops/split.bundle_predicate_params and the
+        score-updater walk consume.  Exact in f32 (all values < 2^24)."""
+        return np.stack([
+            self.feat_col.astype(np.float32),
+            self.feat_offset.astype(np.float32),
+            self.feat_default.astype(np.float32),
+            self.feat_nslots.astype(np.float32),
+            self.feat_packed.astype(np.float32)])
+
+    def unbundle_tables(self, num_bins: np.ndarray, B: int,
+                        num_columns_padded: int = 0
+                        ) -> Tuple[np.ndarray, np.ndarray]:
+        """Gather tables turning a bundled histogram [C, 3, B] into the
+        original per-feature histogram [F, 3, B] (ops/split.unbundle_hist).
+
+        Returns (src [F, B] int32 flat indices into the [C*B + 1] padded
+        store histogram — index C*B is a zero sentinel — and dmask [F, B]
+        bool marking each packed feature's default-bin slot, which is
+        reconstructed as leaf_total - sum(other bins)).
+
+        num_columns_padded: the column count of the histograms that will
+        be unbundled, when the learner pads the store beyond
+        `num_columns` (the rounds learner's int8 layout aligns columns
+        to 32) — the zero sentinel must sit past the PADDED columns, or
+        it would gather a padded column's bin-0 totals instead of zero."""
+        F = self.num_features
+        C = max(self.num_columns, int(num_columns_padded))
+        sent = C * B
+        src = np.full((F, B), sent, np.int32)
+        dmask = np.zeros((F, B), bool)
+        b = np.arange(B)
+        for k in range(F):
+            nb = int(num_bins[k])
+            col = int(self.feat_col[k])
+            if not self.feat_packed[k]:
+                valid = b < nb
+                src[k, valid] = col * B + b[valid]
+                continue
+            d = int(self.feat_default[k])
+            off = int(self.feat_offset[k])
+            valid = (b < nb) & (b != d)
+            slot = b - (b > d)
+            src[k, valid] = col * B + off + slot[valid]
+            if d < nb:
+                dmask[k, d] = True
+        return src, dmask
+
+
+def plan_bundles(sample_bins: np.ndarray, num_bins: np.ndarray,
+                 default_bins: np.ndarray, max_conflict_rate: float,
+                 max_bundle_bins: int = 256, max_probe: int = 128
+                 ) -> Optional[BundlePlan]:
+    """Greedy conflict-graph bundling over SAMPLED binned columns.
+
+    sample_bins : [F, S] int original bin ids of up to S sampled rows
+    num_bins / default_bins : [F] per-used-feature bin count / default bin
+
+    Mirrors the reference's FindGroups greedy first-fit (dataset.cpp):
+    features sorted by non-default count descending; a feature joins the
+    first bundle whose accumulated conflict count stays within
+    `max_conflict_rate * S` and whose bin budget (`max_bundle_bins`, the
+    uint8-store / 256-lane kernel ceiling) is not exceeded.  Dense
+    features (non-default fraction > 0.5) never enter the conflict graph
+    — they become singleton columns immediately, which keeps planning
+    O(sparse^2) instead of O(F^2) on dense data.
+
+    Returns None when no bundle would hold >= 2 features (store unchanged).
+    """
+    F, S = sample_bins.shape
+    if F == 0 or S == 0:
+        return None
+    nd = sample_bins != default_bins[:, None]           # [F, S] non-default
+    nd_cnt = nd.sum(axis=1)
+    budget = int(max_conflict_rate * S)
+    cand = [k for k in range(F)
+            if nd_cnt[k] <= 0.5 * S and 2 <= num_bins[k] <= max_bundle_bins]
+    cand.sort(key=lambda k: -int(nd_cnt[k]))
+
+    bundles: List[List[int]] = []       # member inner indices
+    b_nd: List[np.ndarray] = []         # union non-default mask per bundle
+    b_bins: List[int] = []              # 1 + sum(nb - 1)
+    b_conf: List[int] = []              # accumulated conflict count
+    for k in cand:
+        extra = int(num_bins[k]) - 1
+        placed = False
+        for gi in range(min(len(bundles), max_probe)):
+            if b_bins[gi] + extra > max_bundle_bins:
+                continue
+            c = int(np.count_nonzero(b_nd[gi] & nd[k]))
+            if b_conf[gi] + c <= budget:
+                bundles[gi].append(k)
+                b_nd[gi] |= nd[k]
+                b_bins[gi] += extra
+                b_conf[gi] += c
+                placed = True
+                break
+        if not placed:
+            bundles.append([k])
+            b_nd.append(nd[k].copy())
+            b_bins.append(1 + extra)
+            b_conf.append(0)
+
+    if not any(len(m) > 1 for m in bundles):
+        return None
+
+    feat_col = np.zeros(F, np.int32)
+    feat_offset = np.zeros(F, np.int32)
+    feat_default = np.asarray(default_bins, np.int32).copy()
+    feat_nslots = np.asarray(num_bins, np.int32) - 1
+    feat_packed = np.zeros(F, bool)
+    col_bins: List[int] = []
+    in_bundle = set()
+    for members, nb_total in zip(bundles, b_bins):
+        if len(members) < 2:
+            continue
+        col = len(col_bins)
+        off = 1
+        for k in members:
+            in_bundle.add(k)
+            feat_col[k] = col
+            feat_offset[k] = off
+            feat_packed[k] = True
+            off += int(num_bins[k]) - 1
+        col_bins.append(nb_total)
+    for k in range(F):
+        if k not in in_bundle:
+            feat_col[k] = len(col_bins)
+            col_bins.append(int(num_bins[k]))
+    return BundlePlan(
+        feat_col=feat_col, feat_offset=feat_offset,
+        feat_default=feat_default, feat_nslots=feat_nslots,
+        feat_packed=feat_packed,
+        col_num_bins=np.asarray(col_bins, np.int32),
+        est_conflict_rate=float(sum(b_conf)) / max(S, 1),
+        sample_rows=S)
+
+
+def pack_bundle_column(b: np.ndarray, default_bin: int, offset: int,
+                       out: np.ndarray) -> int:
+    """Fold one member feature's original bins `b` into the bundle column
+    `out` (in place, last writer wins on conflicts).  Returns the number
+    of conflicting rows observed (slots already non-default)."""
+    ndm = b != default_bin
+    conflicts = int(np.count_nonzero(ndm & (out != 0)))
+    slot = b - (b > default_bin)
+    np.copyto(out, (offset + slot).astype(out.dtype), where=ndm)
+    return conflicts
+
+
 def find_bin_mappers(X: np.ndarray, max_bin: int, min_data_in_bin: int,
                      min_split_data: int, categorical: Sequence[int] = (),
                      sample_cnt: int = 200000, seed: int = 1

@@ -73,7 +73,8 @@ class GBDT:
         self.learner = create_tree_learner(train_set, cfg)
         self.train_score = ScoreUpdater(
             self.learner.bins_t, self.num_data, self.K,
-            train_set.metadata.init_score)
+            train_set.metadata.init_score,
+            feat_tbl=train_set.bundle_feat_table())
         # continued training (input_model): replay the loaded model onto
         # the fresh training scores (the reference re-scores via a
         # Predictor closure during loading, application.cpp:106-113)
@@ -117,10 +118,11 @@ class GBDT:
         self._flush_pending()
         cfg = self.config
         bins_np = valid_set.bins.astype(np.int32)
-        pad = np.zeros((valid_set.num_features, 1), np.int32)
+        pad = np.zeros((bins_np.shape[0], 1), np.int32)
         bins_t = jnp.asarray(np.concatenate([bins_np, pad], axis=1).T.copy())
         su = ScoreUpdater(bins_t, valid_set.num_data, self.K,
-                          valid_set.metadata.init_score)
+                          valid_set.metadata.init_score,
+                          feat_tbl=valid_set.bundle_feat_table())
         names = cfg.metric or (default_metric_for_objective(cfg.objective),)
         ms = []
         for nm in names:

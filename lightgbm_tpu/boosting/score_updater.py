@@ -22,11 +22,17 @@ from ..ops.lookup import select_bin_by_feature, table_lookup
 
 
 def _walk_step(node, bins_nt, split_feature, threshold, decision,
-               left_child, right_child, num_nodes):
+               left_child, right_child, num_nodes, feat_tbl=None):
     """One tree level for every row at once.  All per-node lookups go
     through the one-hot matmul (ops/lookup.py) — XLA's [N] table gathers
     and 2-D `bins[rows, feat]` gathers serialize on TPU and cost more than
-    the whole histogram pass; child ids are exact in f32 (|v| < 2^24)."""
+    the whole histogram pass; child ids are exact in f32 (|v| < 2^24).
+
+    feat_tbl (optional [5, F]: col, offset, default, nslots, packed) maps
+    the node's ORIGINAL inner feature onto a bundled store column and
+    recovers the original bin from the packed slot — trees always speak
+    original (feature, threshold-bin) space, so an EFB store needs this
+    second lookup; unbundled stores skip it entirely."""
     nd = jnp.maximum(node, 0)
     tbl = jnp.stack([split_feature.astype(jnp.float32),
                      threshold.astype(jnp.float32),
@@ -37,7 +43,21 @@ def _walk_step(node, bins_nt, split_feature, threshold, decision,
     feat = r[0].astype(jnp.int32)
     t = r[1].astype(jnp.int32)
     d = r[2]
-    bv = select_bin_by_feature(bins_nt.T, feat)
+    if feat_tbl is None:
+        bv = select_bin_by_feature(bins_nt.T, feat)
+    else:
+        fr = table_lookup(jnp.asarray(feat_tbl), feat,
+                          num_slots=feat_tbl.shape[1])
+        col = fr[0].astype(jnp.int32)
+        off = fr[1].astype(jnp.int32)
+        dflt = fr[2].astype(jnp.int32)
+        ns = fr[3].astype(jnp.int32)
+        pk = fr[4] > 0
+        bv_store = select_bin_by_feature(bins_nt.T, col)
+        s = bv_store - off
+        in_r = (s >= 0) & (s < ns)
+        orig = jnp.where(in_r, s + (s >= dflt).astype(jnp.int32), dflt)
+        bv = jnp.where(pk, orig, bv_store)
     go_left = jnp.where(d == 1, bv == t, bv <= t)
     nxt = jnp.where(go_left, r[3], r[4]).astype(jnp.int32)
     return jnp.where(node < 0, node, nxt)
@@ -47,11 +67,12 @@ def _walk_step(node, bins_nt, split_feature, threshold, decision,
 def predict_binned_leaf(bins_t: jax.Array, split_feature_inner: jax.Array,
                         threshold_in_bin: jax.Array, decision_type: jax.Array,
                         left_child: jax.Array, right_child: jax.Array,
-                        *, depth: int) -> jax.Array:
+                        feat_tbl=None, *, depth: int) -> jax.Array:
     """Leaf index per row by walking the tree `depth` levels.
 
-    bins_t: [N+1, F] int bins.  Tree arrays are padded to fixed length so
-    the jit cache keys only on `depth`.
+    bins_t: [N+1, C] int STORE bins (C = original features, or bundled
+    columns with `feat_tbl` given).  Tree arrays are padded to fixed
+    length so the jit cache keys only on `depth`.
     """
     N = bins_t.shape[0] - 1
     node = jnp.zeros(N, jnp.int32)
@@ -61,7 +82,7 @@ def predict_binned_leaf(bins_t: jax.Array, split_feature_inner: jax.Array,
     def step(_, node):
         return _walk_step(node, bins_nt, split_feature_inner,
                           threshold_in_bin, decision_type, left_child,
-                          right_child, nn)
+                          right_child, nn, feat_tbl)
 
     node = jax.lax.fori_loop(0, max(depth, 1), step, node)
     return ~node
@@ -69,7 +90,8 @@ def predict_binned_leaf(bins_t: jax.Array, split_feature_inner: jax.Array,
 
 @jax.jit
 def traverse_tree_device(bins_t, split_feature, threshold_bin, is_cat,
-                         left_child, right_child, num_leaves) -> jax.Array:
+                         left_child, right_child, num_leaves,
+                         feat_tbl=None) -> jax.Array:
     """Leaf index per row from DEVICE tree arrays (learner TreeArrays) —
     no host tree needed, so the pipelined training path can score valid
     sets without waiting for the tree fetch.  A `while_loop` walks until
@@ -91,7 +113,7 @@ def traverse_tree_device(bins_t, split_feature, threshold_bin, is_cat,
     def body(st):
         i, node = st
         node = _walk_step(node, bins_nt, split_feature, threshold_bin,
-                          is_cat, left_child, right_child, nn)
+                          is_cat, left_child, right_child, nn, feat_tbl)
         return i + 1, node
 
     _, node = jax.lax.while_loop(cond, body, (jnp.int32(0), node))
@@ -119,8 +141,11 @@ class ScoreUpdater:
     """Holds [K, N] float32 raw scores for one dataset."""
 
     def __init__(self, bins_t: Optional[jax.Array], num_data: int, K: int,
-                 init_score: Optional[np.ndarray] = None):
+                 init_score: Optional[np.ndarray] = None, feat_tbl=None):
         self.bins_t = bins_t
+        # [5, F] bundle walk table when bins_t is an EFB store (see
+        # _walk_step), None for the plain per-feature layout
+        self.feat_tbl = None if feat_tbl is None else jnp.asarray(feat_tbl)
         self.num_data = num_data
         self.K = K
         self.has_init_score = init_score is not None
@@ -144,7 +169,7 @@ class ScoreUpdater:
         return predict_binned_leaf(
             self.bins_t, d["split_feature_inner"], d["threshold_in_bin"],
             d["decision_type"], d["left_child"], d["right_child"],
-            depth=d["depth"])
+            self.feat_tbl, depth=d["depth"])
 
     def add_tree(self, tree, tree_id: int, scale: float = 1.0) -> None:
         """Whole-data tree predict path (score_updater.hpp AddScore(tree))."""
@@ -164,7 +189,8 @@ class ScoreUpdater:
         `leaf_values` carries shrinkage/clamp pre-applied."""
         leaf_idx = traverse_tree_device(
             self.bins_t, arrs.split_feature, arrs.threshold_bin,
-            arrs.is_cat, arrs.left_child, arrs.right_child, arrs.num_leaves)
+            arrs.is_cat, arrs.left_child, arrs.right_child, arrs.num_leaves,
+            self.feat_tbl)
         self.score = self.score.at[tree_id].set(
             _add_from_leaf(self.score[tree_id], leaf_idx,
                            leaf_values.astype(jnp.float32)))

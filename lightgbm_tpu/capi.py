@@ -92,7 +92,8 @@ class CApiDataset:
         cfg = config_from_params(reference.params)
         inner = _InnerDataset._empty_from_mappers(
             cfg, ref.mappers, list(ref.used_features), int(num_total_row),
-            ref.num_total_features, list(ref.feature_names))
+            ref.num_total_features, list(ref.feature_names),
+            plan=ref.bundle_plan)
         ds = cls(None, reference.params, reference)
         ds.inner = inner
         return ds
@@ -299,8 +300,14 @@ def dataset_get_subset(ds: CApiDataset, idx_addr: int, num_idx: int,
     cfg = config_from_params(params)
     sub = _InnerDataset._empty_from_mappers(
         cfg, inner.mappers, list(inner.used_features), int(num_idx),
-        inner.num_total_features, list(inner.feature_names))
+        inner.num_total_features, list(inner.feature_names),
+        plan=inner.bundle_plan)
     sub.bins = np.ascontiguousarray(inner.bins[:, idx])
+    # conflicts of the selected rows are not recoverable from the bundled
+    # store; carry a proportional ESTIMATE so realized_conflict_rate()
+    # stays in [0, 1] instead of inheriting the full dataset's count
+    sub.bundle_conflict_rows = int(round(
+        inner.bundle_conflict_rows * num_idx / max(inner.num_data, 1)))
     md = Metadata()
     md.label = np.asarray(inner.metadata.label, np.float32)[idx].copy()
     if inner.metadata.weights is not None:

@@ -105,6 +105,13 @@ PARAM_ALIASES: Dict[str, str] = {
     "serve_flush_deadline_ms": "flush_deadline_ms",
     "model_poll": "model_poll_seconds",
     "poll_seconds": "model_poll_seconds",
+    # exclusive feature bundling (EFB)
+    "efb": "enable_bundle",
+    "bundle": "enable_bundle",
+    "enable_feature_bundle": "enable_bundle",
+    "is_enable_bundle": "enable_bundle",
+    "max_conflict": "max_conflict_rate",
+    "bundle_conflict_rate": "max_conflict_rate",
 }
 
 # objective name aliases (reference config.cpp GetObjectiveType handling)
@@ -199,6 +206,10 @@ class Config:
     bin_construct_sample_cnt: int = 200000
     sparse_threshold: float = 0.8
     min_data_in_bin: int = 3
+    # Exclusive Feature Bundling: pack mutually-exclusive features into
+    # shared histogram columns (docs/Bundling.md).  max_conflict_rate is
+    # the tolerated fraction of rows where two bundled features are both
+    # non-default (0.0 = only provably exclusive features bundle).
     enable_bundle: bool = True
     max_conflict_rate: float = 0.0
 
@@ -398,6 +409,8 @@ def check_param_conflict(cfg: Config) -> None:
         raise ValueError("flush_deadline_ms must be >= 0")
     if cfg.model_poll_seconds < 0:
         raise ValueError("model_poll_seconds must be >= 0")
+    if not (0.0 <= cfg.max_conflict_rate < 1.0):
+        raise ValueError("max_conflict_rate must be in [0, 1)")
 
 
 def parse_config_file(path: str) -> Dict[str, str]:

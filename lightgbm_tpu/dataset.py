@@ -25,8 +25,60 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .binning import BinMapper, find_bin_mappers, CATEGORICAL, NUMERICAL
+from .binning import (BinMapper, BundlePlan, find_bin_mappers,
+                      pack_bundle_column, plan_bundles, CATEGORICAL,
+                      NUMERICAL)
 from .config import Config
+
+# rows used to estimate pairwise feature conflicts when planning bundles;
+# planning is O(sparse_features^2 * rows) so the sample is capped tighter
+# than bin_construct_sample_cnt (the estimate only gates which features
+# share a column — realized conflicts are counted exactly during binning)
+BUNDLE_PLAN_SAMPLE_CNT = 50_000
+
+
+def _plan_bundles_from_sample(sample: np.ndarray, mappers: List[BinMapper],
+                              used: List[int], cfg: Config
+                              ) -> Optional[BundlePlan]:
+    """Bundle plan from a raw-valued row sample (bins each used feature
+    with its mapper, then runs the greedy conflict-graph planner).
+    Returns None when bundling is off or nothing bundles."""
+    if not cfg.enable_bundle or not used:
+        return None
+    n = len(sample)
+    if n == 0:
+        return None
+    if n > BUNDLE_PLAN_SAMPLE_CNT:
+        rng = np.random.RandomState(cfg.data_random_seed)
+        sample = sample[np.sort(rng.choice(n, BUNDLE_PLAN_SAMPLE_CNT,
+                                           replace=False))]
+    sb = np.stack([mappers[i].value_to_bin(
+        np.asarray(sample[:, i], np.float64)) for i in used])
+    nb = np.asarray([mappers[i].num_bin for i in used], np.int32)
+    db = np.asarray([mappers[i].default_bin for i in used], np.int32)
+    return plan_bundles(sb, nb, db, cfg.max_conflict_rate)
+
+
+def _log_bundle_state(plan: Optional[BundlePlan], num_used: int,
+                      cfg: Config) -> None:
+    """The one-line construction log the enable_bundle satellite asks for,
+    plus always-on profiling counters for /stats and bench.py."""
+    from . import log, profiling
+    if plan is None:
+        if cfg.verbose >= 1:
+            log.info(f"EFB: bundling {'off' if not cfg.enable_bundle else 'inactive (no exclusive features)'}; "
+                     f"{num_used} features histogrammed directly")
+        return
+    n_multi = plan.num_bundles
+    profiling.count("bundle.features", num_used)
+    profiling.count("bundle.columns", plan.num_columns)
+    profiling.count("bundle.packed_features", plan.num_packed)
+    if cfg.verbose >= 1:
+        log.info(
+            f"EFB: bundled {num_used} features into {plan.num_columns} "
+            f"columns ({n_multi} bundles holding {plan.num_packed} "
+            f"features; sampled conflict rate {plan.est_conflict_rate:.4f} "
+            f"summed over bundles, budget {cfg.max_conflict_rate:g} each)")
 
 
 # ----------------------------------------------------------------------------
@@ -395,21 +447,25 @@ def load_file_two_round(path: str, cfg: Config,
             raise ValueError("validation data has different #features")
         mappers = reference.mappers
         used = reference.used_features
+        plan = reference.bundle_plan
     else:
         mappers = find_bin_mappers(
             sample, cfg.max_bin, cfg.min_data_in_bin, cfg.min_data_in_leaf,
             categorical=cats, sample_cnt=len(sample),
             seed=cfg.data_random_seed)
         used = [i for i, m in enumerate(mappers) if not m.is_trivial]
+        plan = _plan_bundles_from_sample(sample, mappers, used, cfg)
+        _log_bundle_state(plan, len(used), cfg)
 
     # ---- pass 2: bin straight into the store ----------------------------
     ds = Dataset._empty_from_mappers(cfg, mappers, used, n,
-                                     sample.shape[1], x_names)
+                                     sample.shape[1], x_names, plan=plan)
     row = 0
     for ch in chunks():
         arr = ch.to_numpy(dtype=np.float64)
         ds._bin_rows_into(arr[:, use_cols], row)
         row += len(arr)
+    ds._check_realized_conflicts()
     ds.metadata = md
     return ds
 
@@ -442,11 +498,14 @@ class Dataset:
         self.feature_names = feature_names or [f"Column_{i}" for i in range(num_raw)]
 
         if reference is not None:
-            # align with reference (valid set): reuse its mappers
+            # align with reference (valid set): reuse its mappers AND its
+            # bundle plan — a valid set binned into a different column
+            # layout could not share the training walk/unbundle tables
             if num_raw != reference.num_total_features:
                 raise ValueError("validation data has different #features")
             self.mappers = reference.mappers
             self.used_features = reference.used_features
+            plan = reference.bundle_plan
         else:
             self.mappers = find_bin_mappers(
                 X, cfg.max_bin, cfg.min_data_in_bin, cfg.min_data_in_leaf,
@@ -455,18 +514,14 @@ class Dataset:
                 seed=cfg.data_random_seed)
             self.used_features = [i for i, m in enumerate(self.mappers)
                                   if not m.is_trivial]
-        F = len(self.used_features)
-        self.num_bins = np.array(
-            [self.mappers[i].num_bin for i in self.used_features], dtype=np.int32)
-        self.max_num_bin = int(self.num_bins.max()) if F else 1
-        dtype = np.uint8 if self.max_num_bin <= 256 else np.uint16
-        self.bins = np.empty((F, n), dtype=dtype)
-        self.is_categorical = np.array(
-            [self.mappers[i].bin_type == CATEGORICAL for i in self.used_features],
-            dtype=bool)
+            plan = _plan_bundles_from_sample(X, self.mappers,
+                                             self.used_features, cfg)
+            _log_bundle_state(plan, len(self.used_features), cfg)
+        self._init_store(plan, n)
         # numerical columns go through the native bulk binner when built
         # (src/native/loader.cpp lgbt_bin_numerical); the rest via NumPy
         self._bin_rows_into(X, 0)
+        self._check_realized_conflicts()
 
         md = metadata or Metadata()
         if label is not None:
@@ -480,10 +535,40 @@ class Dataset:
 
     # -- helpers ------------------------------------------------------------
 
+    def _init_store(self, plan: Optional[BundlePlan], n: int) -> None:
+        """Derive the per-feature metadata and allocate the binned store.
+
+        `num_bins` / `is_categorical` keep their ORIGINAL per-used-feature
+        semantics (split search and tree building never see bundles);
+        `bins` / `store_num_bins` / `max_num_bin` describe the STORED
+        columns — identical to the original view when plan is None, the
+        narrower bundled layout otherwise."""
+        used = self.used_features
+        F = len(used)
+        self.num_bins = np.array([self.mappers[i].num_bin for i in used],
+                                 dtype=np.int32)
+        self.is_categorical = np.array(
+            [self.mappers[i].bin_type == CATEGORICAL for i in used],
+            dtype=bool)
+        self.bundle_plan = plan
+        self.bundle_conflict_rows = 0
+        if plan is None:
+            self.store_num_bins = self.num_bins
+        else:
+            self.store_num_bins = plan.col_num_bins
+        C = len(self.store_num_bins)
+        self.max_num_bin = int(self.store_num_bins.max()) if C else 1
+        dtype = np.uint8 if self.max_num_bin <= 256 else np.uint16
+        # packed columns rely on 0 meaning "all members at default"
+        self.bins = (np.empty((C, n), dtype=dtype) if plan is None
+                     else np.zeros((C, n), dtype=dtype))
+        self._device_bins = None
+
     @classmethod
     def _empty_from_mappers(cls, cfg: Config, mappers: List[BinMapper],
                             used: List[int], n: int, num_total: int,
-                            feature_names: Optional[List[str]]) -> "Dataset":
+                            feature_names: Optional[List[str]],
+                            plan: Optional[BundlePlan] = None) -> "Dataset":
         """Allocate a Dataset shell (store + derived per-feature metadata)
         from existing bin mappers; callers fill `bins` and `metadata`.
         The single place the mapper→store derivation lives — __init__ and
@@ -496,24 +581,22 @@ class Dataset:
                             or [f"Column_{i}" for i in range(num_total)])
         ds.mappers = mappers
         ds.used_features = used
-        F = len(used)
-        ds.num_bins = np.array([mappers[i].num_bin for i in used],
-                               dtype=np.int32)
-        ds.max_num_bin = int(ds.num_bins.max()) if F else 1
-        dtype = np.uint8 if ds.max_num_bin <= 256 else np.uint16
-        ds.bins = np.empty((F, n), dtype=dtype)
-        ds.is_categorical = np.array(
-            [mappers[i].bin_type == CATEGORICAL for i in used], dtype=bool)
+        ds._init_store(plan, n)
         ds.metadata = Metadata()
-        ds._device_bins = None
         return ds
 
     def _bin_rows_into(self, X: np.ndarray, row0: int) -> None:
         """Bin raw rows X into self.bins[:, row0:row0+len(X)], using the
-        native bulk binner for uint8 numerical columns when built."""
+        native bulk binner for uint8 numerical columns when built.  With
+        a bundle plan, packed features fold into their shared column
+        (last writer wins on conflicting rows; realized conflicts are
+        counted into `bundle_conflict_rows`)."""
         dtype = self.bins.dtype
+        plan = self.bundle_plan
+        sl = slice(row0, row0 + len(X))
         num_ks = [k for k, i in enumerate(self.used_features)
-                  if self.mappers[i].bin_type == NUMERICAL]
+                  if self.mappers[i].bin_type == NUMERICAL
+                  and (plan is None or not plan.feat_packed[k])]
         done = set()
         if dtype == np.uint8 and num_ks:
             from .native import bin_numerical_native
@@ -522,12 +605,169 @@ class Dataset:
             out = bin_numerical_native(np.ascontiguousarray(X), cols, uppers)
             if out is not None:
                 for j, k in enumerate(num_ks):
-                    self.bins[k, row0:row0 + len(X)] = out[j]
+                    c = k if plan is None else int(plan.feat_col[k])
+                    self.bins[c, sl] = out[j]
                 done = set(num_ks)
         for k, i in enumerate(self.used_features):
-            if k not in done:
-                self.bins[k, row0:row0 + len(X)] = self.mappers[
-                    i].value_to_bin(X[:, i]).astype(dtype)
+            if k in done:
+                continue
+            b = self.mappers[i].value_to_bin(X[:, i])
+            if plan is None or not plan.feat_packed[k]:
+                c = k if plan is None else int(plan.feat_col[k])
+                self.bins[c, sl] = b.astype(dtype)
+            else:
+                self.bundle_conflict_rows += pack_bundle_column(
+                    b, int(plan.feat_default[k]), int(plan.feat_offset[k]),
+                    self.bins[int(plan.feat_col[k]), sl])
+
+    def _bin_column_into(self, k: int, values: np.ndarray) -> None:
+        """Bin ONE used feature's full raw column into the store — the
+        column-streaming entry the scipy-CSC path uses so the dense
+        [N, F] matrix never materializes."""
+        plan = self.bundle_plan
+        b = self.mappers[self.used_features[k]].value_to_bin(values)
+        if plan is None or not plan.feat_packed[k]:
+            c = k if plan is None else int(plan.feat_col[k])
+            self.bins[c, :] = b.astype(self.bins.dtype)
+        else:
+            self.bundle_conflict_rows += pack_bundle_column(
+                b, int(plan.feat_default[k]), int(plan.feat_offset[k]),
+                self.bins[int(plan.feat_col[k])])
+
+    @classmethod
+    def from_csc(cls, sp_matrix, label: Optional[np.ndarray],
+                 cfg: Config, metadata: Optional[Metadata] = None,
+                 feature_names: Optional[List[str]] = None,
+                 categorical_feature: Sequence[int] = (),
+                 reference: Optional["Dataset"] = None) -> "Dataset":
+        """Construct from a scipy sparse matrix WITHOUT densifying it
+        whole: a row sample is densified once for BinMapper construction
+        (exactly what the dense path samples anyway), then each column is
+        densified one at a time and binned straight into the store.  Peak
+        memory ≈ binned store + sample + one dense column, instead of the
+        full N×F float64 matrix."""
+        sp = sp_matrix.tocsc()
+        n, num_raw = sp.shape
+        # ---- dense row sample for FindBin ---------------------------------
+        S = min(int(cfg.bin_construct_sample_cnt), n)
+        rng = np.random.RandomState(cfg.data_random_seed)
+        rows = (np.sort(rng.choice(n, S, replace=False)) if n > S
+                else np.arange(n))
+        sample = np.zeros((len(rows), num_raw), np.float64)
+        indptr, indices, data = sp.indptr, sp.indices, sp.data
+        for j in range(num_raw):
+            s, e = int(indptr[j]), int(indptr[j + 1])
+            if s == e:
+                continue
+            pos = np.searchsorted(rows, indices[s:e])
+            hit = (pos < len(rows))
+            hit[hit] = rows[pos[hit]] == indices[s:e][hit]
+            sample[pos[hit], j] = np.asarray(data[s:e], np.float64)[hit]
+        if reference is not None:
+            if num_raw != reference.num_total_features:
+                raise ValueError("validation data has different #features")
+            mappers = reference.mappers
+            used = reference.used_features
+            plan = reference.bundle_plan
+        else:
+            mappers = find_bin_mappers(
+                sample, cfg.max_bin, cfg.min_data_in_bin,
+                cfg.min_data_in_leaf, categorical=categorical_feature,
+                sample_cnt=len(sample), seed=cfg.data_random_seed)
+            used = [i for i, m in enumerate(mappers) if not m.is_trivial]
+            plan = _plan_bundles_from_sample(sample, mappers, used, cfg)
+            _log_bundle_state(plan, len(used), cfg)
+        ds = cls._empty_from_mappers(cfg, mappers, used, n, num_raw,
+                                     feature_names, plan=plan)
+        # ---- stream one dense column at a time ----------------------------
+        col = np.empty(n, np.float64)
+        for k, i in enumerate(used):
+            col[:] = 0.0
+            s, e = int(indptr[i]), int(indptr[i + 1])
+            col[indices[s:e]] = data[s:e]
+            ds._bin_column_into(k, col)
+        ds._check_realized_conflicts()
+        md = metadata or Metadata()
+        if label is not None:
+            md.label = np.asarray(label, dtype=np.float32).reshape(-1)
+        if md.label.size == 0:
+            md.label = np.zeros(n, dtype=np.float32)
+        if md.label.size != n:
+            raise ValueError("label length mismatch")
+        ds.metadata = md
+        return ds
+
+    # -- bundle views --------------------------------------------------------
+
+    @property
+    def num_store_columns(self) -> int:
+        """Stored (histogrammed) columns — F_eff <= num_features."""
+        return int(self.bins.shape[0])
+
+    def bundle_feat_table(self) -> Optional[np.ndarray]:
+        """[5, F] f32 walk/predicate table, or None when unbundled."""
+        if self.bundle_plan is None:
+            return None
+        return self.bundle_plan.feat_table()
+
+    def unbundle_tables(self, num_bins_padded: int,
+                        num_columns_padded: int = 0):
+        """(src, dmask) gather tables for ops/split.unbundle_hist, or
+        None when the store already is the original per-feature layout.
+        num_columns_padded: pass the learner's padded column count when
+        it pads the store (see BundlePlan.unbundle_tables)."""
+        if self.bundle_plan is None:
+            return None
+        return self.bundle_plan.unbundle_tables(self.num_bins,
+                                                num_bins_padded,
+                                                num_columns_padded)
+
+    def unbundled_bins(self) -> np.ndarray:
+        """Materialize the ORIGINAL [num_features, N] per-feature store
+        from the bundled columns (feature-sharded learners need per-
+        feature rows; everything else consumes the bundled store)."""
+        if self.bundle_plan is None:
+            return self.bins
+        plan = self.bundle_plan
+        F = len(self.used_features)
+        out = np.empty((F, self.num_data), self.bins.dtype)
+        for k in range(F):
+            col = self.bins[int(plan.feat_col[k])]
+            if not plan.feat_packed[k]:
+                out[k] = col
+                continue
+            off = int(plan.feat_offset[k])
+            d = int(plan.feat_default[k])
+            s = col.astype(np.int32) - off
+            in_r = (s >= 0) & (s < int(plan.feat_nslots[k]))
+            orig = np.where(in_r, s + (s >= d), d)
+            out[k] = orig.astype(self.bins.dtype)
+        return out
+
+    def realized_conflict_rate(self) -> float:
+        if self.bundle_plan is None or self.num_data == 0:
+            return 0.0
+        return float(self.bundle_conflict_rows) / float(self.num_data)
+
+    def _check_realized_conflicts(self) -> None:
+        """The plan judges exclusivity on a row SAMPLE; binning counts
+        conflicts exactly.  When the full data conflicts more than the
+        budget promised — in particular ANY conflict under
+        max_conflict_rate=0, which is advertised as exactly lossless —
+        say so loudly instead of silently degrading."""
+        if self.bundle_plan is None or self.bundle_conflict_rows == 0:
+            return
+        rate = self.realized_conflict_rate()
+        budget = float(self.config.max_conflict_rate)
+        if budget == 0.0 or rate > budget * max(self.bundle_plan.num_bundles, 1):
+            from . import log
+            log.warning(
+                f"EFB: {self.bundle_conflict_rows} conflicting rows "
+                f"(rate {rate:.5f}) exceed what the planning sample "
+                f"promised (budget {budget:g}/bundle); conflicting rows "
+                "keep only the last-bundled feature's bin. Set "
+                "enable_bundle=false (or raise bin_construct_sample_cnt) "
+                "for exact training")
 
     @property
     def num_features(self) -> int:
@@ -550,7 +790,8 @@ class Dataset:
         if self._device_bins is None:
             import jax.numpy as jnp
             padded = np.concatenate(
-                [self.bins, np.zeros((self.num_features, 1), self.bins.dtype)],
+                [self.bins,
+                 np.zeros((self.bins.shape[0], 1), self.bins.dtype)],
                 axis=1)
             self._device_bins = jnp.asarray(padded.astype(np.int8 if
                 padded.dtype == np.uint8 else np.int16))
@@ -564,7 +805,7 @@ class Dataset:
     # Stored as a magic line + npz (allow_pickle=False on load: a data
     # file is untrusted input and must never reach pickle).
 
-    BINARY_MAGIC = "lightgbm_tpu.dataset.v2"
+    BINARY_MAGIC = "lightgbm_tpu.dataset.v3"
 
     def save_binary(self, path: str) -> None:
         """Serialize the binned dataset so reloads skip parse+bin."""
@@ -577,7 +818,15 @@ class Dataset:
             "feature_names": np.asarray(self.feature_names, dtype="U"),
             "label": md.label,
             "max_bin": np.int64(self.config.max_bin),
+            "enable_bundle": np.int64(1 if self.config.enable_bundle else 0),
+            "bundle_conflict_rows": np.int64(self.bundle_conflict_rows),
         }
+        if self.bundle_plan is not None:
+            p = self.bundle_plan
+            arrays["bundle_feat"] = np.stack([
+                p.feat_col, p.feat_offset, p.feat_default, p.feat_nslots,
+                p.feat_packed.astype(np.int32)]).astype(np.int64)
+            arrays["bundle_col_bins"] = p.col_num_bins.astype(np.int64)
         for opt, name in ((md.weights, "weights"),
                           (md.query_boundaries, "query_boundaries"),
                           (md.init_score, "init_score")):
@@ -614,9 +863,16 @@ class Dataset:
                 f"binary dataset {path} was built with max_bin="
                 f"{int(d['max_bin'])}, config wants {cfg.max_bin}; "
                 "delete the cache to rebuild")
+        cached_eb = bool(int(d.get("enable_bundle", 0)))
+        if cached_eb != bool(cfg.enable_bundle):
+            # a cache built with the other bundling setting would silently
+            # change the measured kernel shape — force a rebin instead
+            raise ValueError(
+                f"binary dataset {path} was built with enable_bundle="
+                f"{cached_eb}, config wants {cfg.enable_bundle}; "
+                "delete the cache to rebuild")
         ds = cls.__new__(cls)
         ds.config = cfg
-        ds.bins = d["bins"]
         ds.num_data = int(d["num_data"])
         ds.num_total_features = int(d["num_total_features"])
         ds.used_features = [int(i) for i in d["used_features"]]
@@ -633,12 +889,19 @@ class Dataset:
                 sparse_rate=float(fl[2]),
                 bin_upper_bound=d[f"m{i}_upper"],
                 bin_2_categorical=cats))
-        ds.num_bins = np.array([ds.mappers[i].num_bin
-                                for i in ds.used_features], np.int32)
-        ds.max_num_bin = int(ds.num_bins.max()) if ds.used_features else 1
-        ds.is_categorical = np.array(
-            [ds.mappers[i].bin_type == CATEGORICAL
-             for i in ds.used_features], bool)
+        plan = None
+        if "bundle_feat" in d:
+            bf = d["bundle_feat"]
+            plan = BundlePlan(
+                feat_col=bf[0].astype(np.int32),
+                feat_offset=bf[1].astype(np.int32),
+                feat_default=bf[2].astype(np.int32),
+                feat_nslots=bf[3].astype(np.int32),
+                feat_packed=bf[4] > 0,
+                col_num_bins=d["bundle_col_bins"].astype(np.int32))
+        ds._init_store(plan, 0)
+        ds.bins = d["bins"]
+        ds.bundle_conflict_rows = int(d.get("bundle_conflict_rows", 0))
         ds.metadata = Metadata(
             label=d["label"],
             weights=d["weights"] if "weights" in d else None,
@@ -754,6 +1017,7 @@ class Dataset:
                     # valid sets bin with the TRAINING mappers, exactly
                     # like the non-partitioned paths (Dataset::CheckAlign)
                     mappers = reference.mappers
+                    plan = reference.bundle_plan
                 else:
                     rng = np.random.RandomState(cfg.data_random_seed)
                     take = min(cfg.bin_construct_sample_cnt
@@ -761,12 +1025,23 @@ class Dataset:
                     samp = (np.sort(rng.choice(n_local, take,
                                                replace=False))
                             if n_local > 0 else np.zeros(0, np.int64))
-                    mappers = find_bin_mappers_distributed(
-                        X[sl][samp], cfg, categorical=cats)
+                    # bundling is decided ONCE from the allgathered global
+                    # sample: every rank derives the identical plan, so
+                    # the sharded stores stay column-aligned
+                    mappers, gsample = find_bin_mappers_distributed(
+                        X[sl][samp], cfg, categorical=cats,
+                        return_sample=True)
+                    used0 = [i for i, m in enumerate(mappers)
+                             if not m.is_trivial]
+                    plan = _plan_bundles_from_sample(gsample, mappers,
+                                                     used0, cfg)
+                    _log_bundle_state(plan, len(used0), cfg)
                 used = [i for i, m in enumerate(mappers) if not m.is_trivial]
                 ds = Dataset._empty_from_mappers(
-                    cfg, mappers, used, n_local, X.shape[1], x_names)
+                    cfg, mappers, used, n_local, X.shape[1], x_names,
+                    plan=plan)
                 ds._bin_rows_into(X[sl], 0)
+                ds._check_realized_conflicts()
                 init_local = None
                 if md.init_score is not None:
                     # init_score may be flattened [N * K] class-major

@@ -181,7 +181,8 @@ def allgather_f64(arr) -> "np.ndarray":
     return out.view(np.float64)
 
 
-def find_bin_mappers_distributed(local_sample, cfg, categorical=()):
+def find_bin_mappers_distributed(local_sample, cfg, categorical=(),
+                                 return_sample=False):
     """Global BinMappers from per-process local samples.
 
     The reference shards FEATURES across machines, finds local mappers,
@@ -189,16 +190,21 @@ def find_bin_mappers_distributed(local_sample, cfg, categorical=()):
     Here the sample rows are allgathered instead (one collective on a
     [S, F] float array) and every process derives identical mappers from
     the identical global sample — no mapper serialization format needed,
-    determinism by construction."""
+    determinism by construction.
+
+    return_sample=True also returns the identical-on-every-rank global
+    sample, so rank-consistent derived decisions (the EFB bundle plan)
+    can be computed from it without a second collective."""
     import jax
     import numpy as np
     from .binning import find_bin_mappers
 
     if jax.process_count() == 1:
-        return find_bin_mappers(
+        m = find_bin_mappers(
             local_sample, cfg.max_bin, cfg.min_data_in_bin,
             cfg.min_data_in_leaf, categorical=categorical,
             sample_cnt=len(local_sample), seed=cfg.data_random_seed)
+        return (m, local_sample) if return_sample else m
     from jax.experimental import multihost_utils
 
     # pad local samples to one shape (process sample sizes can differ by
@@ -217,7 +223,8 @@ def find_bin_mappers_distributed(local_sample, cfg, categorical=()):
         idx = np.random.RandomState(cfg.data_random_seed).choice(
             len(flat), cap, replace=False)
         flat = flat[np.sort(idx)]
-    return find_bin_mappers(
+    m = find_bin_mappers(
         flat, cfg.max_bin, cfg.min_data_in_bin, cfg.min_data_in_leaf,
         categorical=categorical, sample_cnt=len(flat),
         seed=cfg.data_random_seed)
+    return (m, flat) if return_sample else m

@@ -90,10 +90,11 @@ def padded_bin_count(max_num_bin: int) -> int:
 
 
 def sentinel_bins_t(dataset) -> np.ndarray:
-    """[N+1, F] int32 transpose with a sentinel row at index N (bin 0) so
-    padded gathers are branch-free."""
+    """[N+1, C] int32 transpose of the STORE (per-feature rows, or EFB
+    bundle columns) with a sentinel row at index N (bin 0) so padded
+    gathers are branch-free."""
     bins_np = dataset.bins.astype(np.int32)
-    pad = np.zeros((dataset.num_features, 1), np.int32)
+    pad = np.zeros((bins_np.shape[0], 1), np.int32)
     return np.concatenate([bins_np, pad], axis=1).T.copy()
 
 

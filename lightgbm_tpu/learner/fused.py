@@ -39,7 +39,9 @@ from ..dataset import Dataset
 from .common import (make_split_kw, padded_bin_count, sentinel_bins_t,
                      use_parent_hist_cache)
 from ..ops.histogram import histogram_full_masked
-from ..ops.split import best_split, leaf_output
+from ..ops.split import (best_split, bundle_predicate_params,
+                         identity_feat_table, leaf_output, maybe_unbundle,
+                         store_go_left)
 from ..tree import Tree, NUMERICAL_DECISION, CATEGORICAL_DECISION
 from ..binning import CATEGORICAL
 
@@ -67,7 +69,8 @@ def _psum(x, axis):
     return jax.lax.psum(x, axis) if axis is not None else x
 
 
-def build_tree(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
+def build_tree(bins, grad, hess, row_mask, num_bins, is_cat, fmask, ftbl,
+               unb=None, *,
                num_leaves: int, num_bins_padded: int, split_kw: tuple,
                max_depth: int, min_data_in_leaf: int,
                min_sum_hessian_in_leaf: float,
@@ -81,9 +84,15 @@ def build_tree(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
     """Grow one tree; runs per-shard inside `shard_map` (or standalone when
     both axes are None).
 
-    bins     : [Floc, Nloc] int  — this shard's bin ids
+    bins     : [Floc, Nloc] int  — this shard's STORE columns (= original
+               per-feature bins, or bundled columns under EFB)
     grad/hess/row_mask : [Nloc] f32 (row_mask is 0 for padding / out-of-bag)
-    num_bins/is_cat/fmask : [Floc] per-feature metadata for this shard
+    num_bins/is_cat/fmask : per-ORIGINAL-feature metadata for this shard
+    ftbl     : [5, F] feature→(col, offset, default, nslots, packed) table
+               (identity when the store is unbundled)
+    unb      : None, or (src, dmask) unbundle-gather tables — then the
+               store is bundled (single feature shard only) and every
+               histogram is unbundled before split search
     Returns (TreeArrays, leaf_id [Nloc] int32).
     """
     Floc, Nloc = bins.shape
@@ -117,7 +126,8 @@ def build_tree(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
         the leaf's GLOBAL (sum_grad, sum_hess, count)."""
         if voting:
             return find_best_voting(hist, sums)
-        rec = best_split(hist, num_bins, is_cat, fmask,
+        rec = best_split(maybe_unbundle(hist, unb, sums),
+                         num_bins, is_cat, fmask,
                          sums[0], sums[1], sums[2], **skw)
         p = rec.packed()
         p = p.at[1].add(f_off.astype(jnp.float32))
@@ -160,13 +170,15 @@ def build_tree(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
         return can_gate(p, sums)
 
     def go_left_row(feat, thr, catf):
-        """[Nloc] bool: does each local row go left under (feat, thr)?
-        The owning feature shard evaluates; others contribute zeros."""
-        lf = feat - f_off
+        """[Nloc] bool: does each local row go left under the ORIGINAL-
+        space split (feat, thr)?  The owning store-column shard evaluates
+        the store-space predicate; others contribute zeros."""
+        col, T, lo, hi1, dl = bundle_predicate_params(ftbl, feat, thr, catf)
+        lf = col - f_off
         owned = (lf >= 0) & (lf < Floc)
         featrow = jnp.take(bins, jnp.clip(lf, 0, Floc - 1),
                            axis=0).astype(jnp.int32)
-        gl = jnp.where(catf, featrow == thr, featrow <= thr)
+        gl = store_go_left(featrow, T, lo, hi1, dl, catf)
         gl = jnp.where(owned, gl, False)
         if feature_axis is not None:
             gl = jax.lax.psum(gl.astype(jnp.int32), feature_axis) > 0
@@ -234,10 +246,12 @@ def build_tree(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
         new_leaf = jnp.int32(i + 1)
         node = jnp.int32(i)
 
-        # decision type lives with the owning shard's metadata
+        # decision type lives with the owning shard's metadata (sized by
+        # the ORIGINAL feature count, which equals Floc except under EFB)
+        Fm = is_cat.shape[0]
         lf = feat - f_off
-        owned = (lf >= 0) & (lf < Floc)
-        catf = jnp.where(owned, is_cat[jnp.clip(lf, 0, Floc - 1)], False)
+        owned = (lf >= 0) & (lf < Fm)
+        catf = jnp.where(owned, is_cat[jnp.clip(lf, 0, Fm - 1)], False)
         if feature_axis is not None:
             catf = jax.lax.psum(catf.astype(jnp.int32), feature_axis) > 0
 
@@ -423,10 +437,30 @@ class FusedTreeLearner:
             self._local_np = self.Np
         self.Fp = int(self.df * math.ceil(self.F / self.df))
 
-        bins_np = dataset.bins.astype(np.int32)
-        if self.Fp > self.F or self._local_np > self.N:
-            bins_np = np.pad(bins_np, ((0, self.Fp - self.F),
-                                       (0, self._local_np - self.N)))
+        cfg = config
+        voting = (getattr(cfg, "tree_learner", "") == "voting"
+                  and self.dd > 1)
+        # EFB: histogram over the narrower bundled store.  Feature
+        # sharding and voting need per-ORIGINAL-feature store rows (the
+        # vote / shard ownership is per feature), so they fall back to
+        # the unbundled view of the same plan
+        plan = dataset.bundle_plan
+        self.use_bundle = plan is not None and self.df == 1 and not voting
+        if self.use_bundle:
+            store = dataset.bins
+            bins_np = store.astype(np.int32)
+            if self._local_np > self.N:
+                bins_np = np.pad(bins_np,
+                                 ((0, 0), (0, self._local_np - self.N)))
+            self.Cstore = store.shape[0]
+        else:
+            base = (dataset.bins if plan is None
+                    else dataset.unbundled_bins())
+            bins_np = base.astype(np.int32)
+            if self.Fp > self.F or self._local_np > self.N:
+                bins_np = np.pad(bins_np, ((0, self.Fp - self.F),
+                                           (0, self._local_np - self.N)))
+            self.Cstore = self.Fp
         nb = np.pad(dataset.num_bins.astype(np.int32),
                     (0, self.Fp - self.F), constant_values=1)
         ic = np.pad(dataset.is_categorical, (0, self.Fp - self.F))
@@ -434,16 +468,22 @@ class FusedTreeLearner:
                                   (0, self.Fp - self.F))
         self._row_mask = np.pad(np.ones(self.N, np.float32),
                                 (0, self._local_np - self.N))
+        # host-numpy tables close over the traced builders as constants
+        # (shard_map-safe; a few hundred KB at worst)
+        if self.use_bundle:
+            ftbl = plan.feat_table()
+            unb = dataset.unbundle_tables(self.B)
+        else:
+            ftbl = np.asarray(identity_feat_table(nb))
+            unb = None
 
-        cfg = config
         self.split_kw = make_split_kw(cfg)
         self._feat_rng = np.random.RandomState(cfg.feature_fraction_seed)
 
-        voting = (getattr(cfg, "tree_learner", "") == "voting"
-                  and self.dd > 1)
         # histogram-memory bound (reference HistogramPool analog); the
-        # feature count is this shard's local share
-        self.cache_parent_hist = use_parent_hist_cache(cfg, (self.Fp // self.df), self.B)
+        # column count is this shard's local share of the STORE
+        self.cache_parent_hist = use_parent_hist_cache(
+            cfg, self.Cstore // self.df, self.B)
         kw = dict(num_leaves=cfg.num_leaves, num_bins_padded=self.B,
                   split_kw=self.split_kw, max_depth=int(cfg.max_depth),
                   min_data_in_leaf=int(cfg.min_data_in_leaf),
@@ -453,13 +493,13 @@ class FusedTreeLearner:
                   cache_parent_hist=self.cache_parent_hist,
                   input_dtype=getattr(cfg, "histogram_dtype", "float32"))
         if mesh is None:
-            fn = functools.partial(build_tree, **kw)
+            fn = functools.partial(build_tree, ftbl=ftbl, unb=unb, **kw)
             self._build = jax.jit(fn)
             self.bins_dev = jnp.asarray(bins_np)
         else:
             from jax.sharding import PartitionSpec as P, NamedSharding
             fn = functools.partial(
-                build_tree, **kw,
+                build_tree, ftbl=ftbl, unb=unb, **kw,
                 data_axis="data" if self.dd > 1 else None,
                 feature_axis="feature" if self.df > 1 else None,
                 feature_shard_size=self.Fp // self.df)

@@ -44,7 +44,8 @@ from .common import (make_split_kw, padded_bin_count, sentinel_bins_t,
 from .fused import TreeArrays, tree_arrays_to_host
 from ..ops.histogram import hist_multileaf_masked
 from ..ops.partition import partition_rows
-from ..ops.split import best_split, leaf_output
+from ..ops.split import (best_split, bundle_predicate_params,
+                         identity_feat_table, leaf_output, maybe_unbundle)
 from ..tree import Tree
 
 NEG_INF = -jnp.inf
@@ -105,7 +106,8 @@ def _psum(x, axis):
     return jax.lax.psum(x, axis) if axis is not None else x
 
 
-def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
+def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
+                      ftbl=None, unb=None, *,
                       num_leaves: int, num_bins_padded: int, split_kw: tuple,
                       max_num_bin: int = 0,
                       max_depth: int, min_data_in_leaf: int,
@@ -119,6 +121,14 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
     """Grow one tree in batched rounds.  Shapes as learner/fused.build_tree.
     Returns (TreeArrays, leaf_id).
 
+    `bins` holds STORE columns (bundled under EFB); num_bins/is_cat/fmask
+    are per-ORIGINAL-feature.  `ftbl` is the [5, F] feature→column table
+    (identity when unbundled) and `unb` the optional unbundle-gather
+    tables — every histogram is unbundled before split search, so split
+    records, TreeArrays, and leaf partitioning all speak original
+    (feature, threshold) space; only partition_rows sees store columns,
+    through the translated store-space predicate.
+
     cache_parent_hist=False bounds tree-state memory (the analog of the
     reference HistogramPool cap, feature_histogram.hpp:313-475): instead
     of keeping every leaf's [F, 3, B] histogram for the parent-subtraction
@@ -130,6 +140,8 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
     B = num_bins_padded
     K = leaves_per_batch or LEAVES_PER_BATCH
     n_chunks = (L + K - 1) // K
+    if ftbl is None:
+        ftbl = identity_feat_table(num_bins)
     # Termination is governed by the while_loop predicate (no positive gain
     # or num_leaves reached); R is only a provably non-binding safety bound:
     # any round that runs splits >=1 leaf, so L-1 rounds suffice even for a
@@ -146,10 +158,12 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
         binsf = bins.astype(jnp.int32)
 
     def find_best_batch(hists, sums):
-        """hists [K2, F, 3, B], sums [K2, 3] → packed recs [K2, 11] with the
-        can-split gate applied (depth gate is applied at selection time)."""
+        """hists [K2, C, 3, B] STORE histograms, sums [K2, 3] → packed
+        recs [K2, 11] in ORIGINAL feature space (unbundled per leaf),
+        with the can-split gate applied (depth gate at selection time)."""
         def one(h, s):
-            rec = best_split(h, num_bins, is_cat, fmask,
+            rec = best_split(maybe_unbundle(h, unb, s),
+                             num_bins, is_cat, fmask,
                              s[0], s[1], s[2], **skw)
             p = rec.packed()
             can = ((s[2] >= 2 * min_data_in_leaf)
@@ -229,21 +243,27 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask, *,
         r_sums = rec[:, 6:9]
 
         # ---- partition all rows in one pass -------------------------------
-        # per-LEAF lookup of (split feature, threshold, is-cat, new leaf)
+        # per-LEAF lookup of (split column, threshold, is-cat, new leaf)
         # then the per-row bin read and move — fused in one pallas pass
         # (ops/partition.py; XLA fallback composes the one-hot matmuls of
         # ops/lookup.py there).  XLA's [Nloc] table gather runs at
         # <1 GB/s on TPU and cost more than the histogram kernel
         # (65 ms/table at N=4M); new_leaf > 0 ⟺ leaf splits, leaf 0
-        # is never a NEW leaf, so 0 table rows mean "stay"
+        # is never a NEW leaf, so 0 table rows mean "stay".  The split
+        # (feat, thr) is ORIGINAL space; the table carries the translated
+        # STORE-space predicate (ops/split.bundle_predicate_params), so
+        # bundled columns partition without ever materializing original
+        # bins
+        colv, Tv, lov, hi1v, dlv = bundle_predicate_params(
+            ftbl, feat, thr, catf)
         tbl_idx = jnp.where(do, pl_, L)                      # drop-slot L
         zeros = jnp.zeros(L + 1, jnp.float32)
-        tbl = jnp.stack([
-            zeros.at[tbl_idx].set(feat.astype(jnp.float32), mode="drop"),
-            zeros.at[tbl_idx].set(thr.astype(jnp.float32), mode="drop"),
-            zeros.at[tbl_idx].set(catf.astype(jnp.float32), mode="drop"),
-            zeros.at[tbl_idx].set(new_leaf.astype(jnp.float32),
-                                  mode="drop")])
+
+        def srow(v):
+            return zeros.at[tbl_idx].set(v.astype(jnp.float32), mode="drop")
+
+        tbl = jnp.stack([srow(colv), srow(Tv), srow(catf), srow(new_leaf),
+                         srow(lov), srow(hi1v), srow(dlv)])
         leaf_id2 = partition_rows(binsf, leaf_id, tbl, num_slots=L + 1,
                                   backend=backend, num_bins_padded=B)
 
@@ -423,8 +443,11 @@ class RoundsTreeLearner:
             self._local_np = self.Np
 
         backend = ("pallas" if jax.default_backend() == "tpu" else "xla")
-        nbv = dataset.num_bins.astype(np.int32)
-        icv = np.asarray(dataset.is_categorical)
+        nbv = dataset.num_bins.astype(np.int32)      # ORIGINAL [F]
+        icv = np.asarray(dataset.is_categorical)     # ORIGINAL [F]
+        plan = dataset.bundle_plan
+        store = dataset.bins                         # [C, N] (bundled: C<F)
+        self.Cstore = store.shape[0]
         if backend == "pallas" and dataset.max_num_bin <= 256 \
                 and self._want_int8_bins():
             # int8 HBM layout (value - 128): 4x less device memory and
@@ -434,39 +457,53 @@ class RoundsTreeLearner:
             # int32 G=8 layout on wide 255-bin data (Epsilon shape), so
             # narrow storage is chosen only when int32 bins would crowd
             # the device (see _want_int8_bins).
-            bins_np = (dataset.bins.astype(np.int16) - 128).astype(np.int8)
-            # pad features to the int8 kernel's 32-sublane group on the
+            bins_np = (store.astype(np.int16) - 128).astype(np.int8)
+            # pad columns to the int8 kernel's 32-sublane group on the
             # HOST: a device-side pad would briefly hold a second full
-            # copy of the bins array.  Padded features are trivial
+            # copy of the bins array.  Padded columns are trivial
             # (1 bin, fmask False) and can never be selected.
-            self.Fpad = 32 * int(math.ceil(self.F / 32))
+            self.Fpad = 32 * int(math.ceil(self.Cstore / 32))
         else:
-            bins_np = dataset.bins.astype(np.int32)
-            self.Fpad = self.F
+            bins_np = store.astype(np.int32)
+            self.Fpad = self.Cstore
         # pad value must be an in-range bin; padded rows/features carry
         # zero mask so their bin never matters
         pad_val = -128 if bins_np.dtype == np.int8 else 0
-        if self.Fpad > self.F:
-            fp = self.Fpad - self.F
+        if self.Fpad > self.Cstore:
+            fp = self.Fpad - self.Cstore
             bins_np = np.pad(bins_np, ((0, fp), (0, 0)),
                              constant_values=pad_val)
-            nbv = np.pad(nbv, (0, fp), constant_values=1)
-            icv = np.pad(icv, (0, fp))
         if self._local_np > self.N:
             bins_np = np.pad(bins_np, ((0, 0), (0, self._local_np - self.N)),
                              constant_values=pad_val)
+        if plan is None:
+            # unbundled: split metadata mirrors the (padded) store columns
+            fp = self.Fpad - self.F
+            nbv = np.pad(nbv, (0, fp), constant_values=1)
+            icv = np.pad(icv, (0, fp))
+            self._base_fmask = np.pad(np.ones(self.F, bool), (0, fp))
+            ftbl = None
+            unb = None
+        else:
+            # bundled: histograms unbundle to the ORIGINAL [F] layout
+            # before split search, so split metadata keeps original size.
+            # The sentinel in the gather tables must account for the
+            # int8 layout's 32-aligned column padding (histograms come
+            # back [K, Fpad, 3, B]) — a plan-sized sentinel would gather
+            # a padded column's bin-0 totals instead of zero
+            self._base_fmask = np.ones(self.F, bool)
+            ftbl = plan.feat_table()
+            unb = dataset.unbundle_tables(self.B, self.Fpad)
         self._row_mask = np.pad(np.ones(self.N, np.float32),
                                 (0, self._local_np - self.N))
         self._row_mask_dev = None     # lazy device cache (no bagging path)
         self._fmask_dev = None        # lazy device cache (no sampling path)
-        self._base_fmask = np.pad(np.ones(self.F, bool),
-                                  (0, self.Fpad - self.F))
         cfg = config
         self.split_kw = make_split_kw(cfg)
         self._feat_rng = np.random.RandomState(cfg.feature_fraction_seed)
 
         # histogram-memory bound (reference HistogramPool analog); the
-        # feature count is this shard's local share
+        # column count is this shard's local share of the STORE
         self.cache_parent_hist = use_parent_hist_cache(cfg, self.Fpad,
                                                        self.B)
         kw = dict(num_leaves=cfg.num_leaves, num_bins_padded=self.B,
@@ -476,6 +513,7 @@ class RoundsTreeLearner:
                   min_sum_hessian_in_leaf=float(cfg.min_sum_hessian_in_leaf),
                   backend=backend,
                   cache_parent_hist=self.cache_parent_hist,
+                  ftbl=ftbl, unb=unb,
                   input_dtype=getattr(cfg, "histogram_dtype", "float32"))
         if mesh is None:
             self._build = jax.jit(functools.partial(build_tree_rounds, **kw))
@@ -512,8 +550,8 @@ class RoundsTreeLearner:
         if ov in ("0", "1"):
             return ov == "1"
         # bins shard along the data axis: the pressure that matters is
-        # the PER-DEVICE share of the int32 layout
-        int32_bytes = 4.0 * self.F * self.Np / max(self.dd, 1)
+        # the PER-DEVICE share of the int32 STORE layout
+        int32_bytes = 4.0 * self.Cstore * self.Np / max(self.dd, 1)
         try:
             stats = jax.local_devices()[0].memory_stats()
             limit = float(stats.get("bytes_limit", 0)) or 16e9
@@ -535,7 +573,7 @@ class RoundsTreeLearner:
             # padding features stay masked out
             k = max(1, int(round(self.F * frac)))
             sel = self._feat_rng.choice(self.F, size=k, replace=False)
-            mm = np.zeros(self.Fpad, bool)
+            mm = np.zeros(len(self._base_fmask), bool)
             mm[sel] = True
             m &= mm
         return m if self.mh is not None else jnp.asarray(m)

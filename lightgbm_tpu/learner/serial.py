@@ -34,7 +34,9 @@ from ..config import Config
 from ..dataset import Dataset
 from .common import make_split_kw, padded_bin_count, sentinel_bins_t
 from ..ops.histogram import histogram_from_indices
-from ..ops.split import best_split, SplitResult
+from ..ops.split import (best_split, bundle_predicate_params,
+                         identity_feat_table, maybe_unbundle, store_go_left,
+                         SplitResult)
 from ..tree import Tree, NUMERICAL_DECISION, CATEGORICAL_DECISION
 from ..binning import CATEGORICAL
 
@@ -46,16 +48,34 @@ def _next_pow2(n: int) -> int:
 @functools.partial(jax.jit, static_argnames=("cap", "num_bins_padded",
                                              "backend", "split_kw"))
 def _root_step(bins_t, grad_pad, hess_pad, idx, num_bins, is_cat, fmask,
-               *, cap, num_bins_padded, backend, split_kw):
+               unb, *, cap, num_bins_padded, backend, split_kw):
     hist = histogram_from_indices(bins_t, grad_pad, hess_pad, idx,
                                   num_bins_padded=num_bins_padded,
                                   backend=backend)
     sum_g = jnp.sum(hist[0, 0, :])
     sum_h = jnp.sum(hist[0, 1, :])
     cnt = jnp.sum(hist[0, 2, :])
-    rec = best_split(hist, num_bins, is_cat, fmask, sum_g, sum_h, cnt,
+    sums = jnp.stack([sum_g, sum_h, cnt])
+    h = maybe_unbundle(hist, unb, sums)
+    rec = best_split(h, num_bins, is_cat, fmask, sum_g, sum_h, cnt,
                      **dict(split_kw))
-    return hist, rec.packed(), jnp.stack([sum_g, sum_h, cnt])
+    return hist, rec.packed(), sums
+
+
+def _store_partition(bins, leaf_id, parent_leaf, new_leaf, feat, thr,
+                     is_cat_split, ftbl):
+    """Move the parent's right-going rows to new_leaf, evaluating the
+    ORIGINAL-space split (feat, thr) on the bundled store via the
+    store-space predicate (ops/split.bundle_predicate_params)."""
+    N = leaf_id.shape[0]
+    col, T, lo, hi1, dl = bundle_predicate_params(ftbl, feat, thr,
+                                                  is_cat_split)
+    featrow = jax.lax.dynamic_index_in_dim(bins, col, axis=0,
+                                           keepdims=False)[:N]
+    featrow = featrow.astype(jnp.int32)
+    pred = store_go_left(featrow, T, lo, hi1, dl, is_cat_split)
+    in_parent = leaf_id == parent_leaf
+    return jnp.where(in_parent & ~pred, new_leaf, leaf_id)
 
 
 @functools.partial(jax.jit, static_argnames=("cap", "num_bins_padded",
@@ -63,17 +83,15 @@ def _root_step(bins_t, grad_pad, hess_pad, idx, num_bins, is_cat, fmask,
                                              "with_subtract"))
 def _split_step(bins, bins_t, grad_pad, hess_pad, leaf_id, parent_leaf,
                 new_leaf, feat, thr, is_cat_split, smaller_leaf, parent_hist,
-                num_bins, is_cat, fmask, small_sums, large_sums,
+                num_bins, is_cat, fmask, small_sums, large_sums, ftbl, unb,
                 *, cap, num_bins_padded, backend, split_kw, with_subtract):
     """Partition parent rows, histogram the smaller child (gathered, cap
-    static), obtain the larger by subtraction, best-split both."""
+    static), obtain the larger by subtraction, best-split both.  The
+    cached/returned histograms stay in STORE space; split search runs on
+    the unbundled per-feature view."""
     N = leaf_id.shape[0]
-    featrow = jax.lax.dynamic_index_in_dim(bins, feat, axis=0,
-                                           keepdims=False)[:N]
-    featrow = featrow.astype(jnp.int32)
-    pred = jnp.where(is_cat_split, featrow == thr, featrow <= thr)
-    in_parent = leaf_id == parent_leaf
-    leaf_id = jnp.where(in_parent & ~pred, new_leaf, leaf_id)
+    leaf_id = _store_partition(bins, leaf_id, parent_leaf, new_leaf, feat,
+                               thr, is_cat_split, ftbl)
 
     small_mask = leaf_id == smaller_leaf
     idx = jnp.nonzero(small_mask, size=cap, fill_value=N)[0].astype(jnp.int32)
@@ -85,9 +103,11 @@ def _split_step(bins, bins_t, grad_pad, hess_pad, leaf_id, parent_leaf,
     else:
         hist_large = parent_hist  # unused placeholder
     kw = dict(split_kw)
-    rec_small = best_split(hist_small, num_bins, is_cat, fmask,
+    rec_small = best_split(maybe_unbundle(hist_small, unb, small_sums),
+                           num_bins, is_cat, fmask,
                            small_sums[0], small_sums[1], small_sums[2], **kw)
-    rec_large = best_split(hist_large, num_bins, is_cat, fmask,
+    rec_large = best_split(maybe_unbundle(hist_large, unb, large_sums),
+                           num_bins, is_cat, fmask,
                            large_sums[0], large_sums[1], large_sums[2], **kw)
     return (leaf_id, hist_small, hist_large,
             jnp.stack([rec_small.packed(), rec_large.packed()]))
@@ -95,14 +115,9 @@ def _split_step(bins, bins_t, grad_pad, hess_pad, leaf_id, parent_leaf,
 
 @jax.jit
 def _partition_only(bins, leaf_id, parent_leaf, new_leaf, feat, thr,
-                    is_cat_split):
-    N = leaf_id.shape[0]
-    featrow = jax.lax.dynamic_index_in_dim(bins, feat, axis=0,
-                                           keepdims=False)[:N]
-    featrow = featrow.astype(jnp.int32)
-    pred = jnp.where(is_cat_split, featrow == thr, featrow <= thr)
-    in_parent = leaf_id == parent_leaf
-    return jnp.where(in_parent & ~pred, new_leaf, leaf_id)
+                    is_cat_split, ftbl):
+    return _store_partition(bins, leaf_id, parent_leaf, new_leaf, feat,
+                            thr, is_cat_split, ftbl)
 
 
 class _LeafInfo:
@@ -122,20 +137,30 @@ class SerialTreeLearner:
         self.dataset = dataset
         self.config = config
         self.N = dataset.num_data
-        self.F = dataset.num_features
+        self.F = dataset.num_features              # ORIGINAL feature count
+        # bin axis sized by the STORE (bundled columns hold >= any
+        # member's bins, so one padded count serves histogram and the
+        # unbundled split search alike)
         self.B = padded_bin_count(dataset.max_num_bin)
-        bt = sentinel_bins_t(dataset)
-        self.bins = jnp.asarray(bt.T.copy())   # [F, N+1]
-        self.bins_t = jnp.asarray(bt)          # [N+1, F]
+        bt = sentinel_bins_t(dataset)              # store layout [N+1, C]
+        self.bins = jnp.asarray(bt.T.copy())   # [C, N+1]
+        self.bins_t = jnp.asarray(bt)          # [N+1, C]
         self.num_bins_dev = jnp.asarray(dataset.num_bins)
         self.is_cat_dev = jnp.asarray(dataset.is_categorical)
+        ft = dataset.bundle_feat_table()
+        self.ftbl = (identity_feat_table(dataset.num_bins) if ft is None
+                     else jnp.asarray(ft))
+        unb = dataset.unbundle_tables(self.B)
+        self.unb = (None if unb is None
+                    else (jnp.asarray(unb[0]), jnp.asarray(unb[1])))
         self.backend = ("pallas" if config.device_type == "tpu" and
                         jax.default_backend() == "tpu" else "xla")
         cfg = config
         self.split_kw = make_split_kw(cfg)
         self._feat_rng = np.random.RandomState(cfg.feature_fraction_seed)
         # memory guard: keep per-leaf histograms only if the full set fits
-        hist_bytes = self.F * 3 * self.B * 4
+        # (cached histograms live in STORE space — bundling shrinks them)
+        hist_bytes = dataset.num_store_columns * 3 * self.B * 4
         pool_budget = (cfg.histogram_pool_size * 1e6
                        if cfg.histogram_pool_size > 0 else 1.5e9)
         self.keep_hists = hist_bytes * cfg.num_leaves <= pool_budget
@@ -174,7 +199,7 @@ class SerialTreeLearner:
                           fill_value=self.N)[0].astype(jnp.int32)
         hist, packed, sums = _root_step(
             self.bins_t, self._grad_pad, self._hess_pad, idx,
-            self.num_bins_dev, self.is_cat_dev, self._fmask,
+            self.num_bins_dev, self.is_cat_dev, self._fmask, self.unb,
             cap=cap, num_bins_padded=self.B, backend=self.backend,
             split_kw=self.split_kw)
         return hist, np.asarray(packed)
@@ -210,7 +235,7 @@ class SerialTreeLearner:
 
         hist, packed, sums = _root_step(
             self.bins_t, self._grad_pad, self._hess_pad, idx,
-            self.num_bins_dev, self.is_cat_dev, self._fmask,
+            self.num_bins_dev, self.is_cat_dev, self._fmask, self.unb,
             cap=int(idx.shape[0]), num_bins_padded=self.B,
             backend=self.backend, split_kw=self.split_kw)
         sums = np.asarray(sums, dtype=np.float64)
@@ -266,7 +291,8 @@ class SerialTreeLearner:
                 cap = self._cap(small.count)
                 with_subtract = info.hist is not None
                 parent_hist = (info.hist if with_subtract else
-                               jnp.zeros((self.F, 3, self.B), jnp.float32))
+                               jnp.zeros((self.dataset.num_store_columns,
+                                          3, self.B), jnp.float32))
                 (self.leaf_id, hist_small, hist_large, recs) = _split_step(
                     self.bins, self.bins_t, self._grad_pad, self._hess_pad,
                     self.leaf_id, best_leaf, new_leaf, feat, thr,
@@ -276,6 +302,7 @@ class SerialTreeLearner:
                                  float(small.count)], jnp.float32),
                     jnp.asarray([large.sum_grad, large.sum_hess,
                                  float(large.count)], jnp.float32),
+                    self.ftbl, self.unb,
                     cap=cap, num_bins_padded=self.B, backend=self.backend,
                     split_kw=self.split_kw, with_subtract=with_subtract)
                 recs = np.asarray(recs)
@@ -296,7 +323,7 @@ class SerialTreeLearner:
             else:
                 self.leaf_id = _partition_only(
                     self.bins, self.leaf_id, best_leaf, new_leaf, feat, thr,
-                    is_cat_split)
+                    is_cat_split, self.ftbl)
 
             leaves[best_leaf] = left
             leaves[new_leaf] = right

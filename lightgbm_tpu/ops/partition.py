@@ -12,17 +12,25 @@ iteration once the histogram kernels are narrow.
 
 The pallas kernel fuses the whole step in VMEM per row-chunk:
 
-- ONE int8 [8, S] @ [S, Ck] matmul performs ALL FOUR table lookups: the
+- ONE int8 [8, S] @ [S, Ck] matmul performs ALL table lookups: the
   slot one-hot is built with the narrow int8 compare (ids - 128, exact
   while S <= 256 — same window argument as ops/histogram._packed_onehot)
-  and the table rows carry threshold-128, is-cat, new-leaf-128 and the
-  split feature as two base-128 digits (f_hi, f_lo), every entry in
+  and the table rows carry threshold-128, is-cat|default-left flags,
+  new-leaf-128, the in-range window bounds lo-128 / hi-128, and the
+  split column as two base-128 digits (c_hi, c_lo), every entry in
   int8 range, each product exact, int32 accumulation of a single
   non-zero per column.
-- the row's bin of its split feature is a compare-reduce over the
+- the row's bin of its split column is a compare-reduce over the
   feature axis of the SAME bins block the histogram kernel streams
   (no [N, F] one-hot ever leaves VMEM).
 - the left/right decision and the new leaf id are elementwise.
+
+With Exclusive Feature Bundling the stored column packs several original
+features; the per-leaf table then carries the STORE-space predicate from
+ops/split.bundle_predicate_params: rows inside the feature's slot window
+[lo, hi] compare against T, rows outside sit at the feature's default
+bin and take the precomputed default-left bit.  An unbundled split is
+the degenerate window [0, inf) — the same kernel serves both.
 
 HBM traffic collapses to: bins read once, lid read once, lid2 written
 once.
@@ -51,11 +59,25 @@ def disable_fused_partition():
     _partition_pallas.clear_cache()
 
 
+def _augment_tbl(tbl: jax.Array) -> jax.Array:
+    """Accept the legacy [4, S] (feature, threshold, is-cat, new-leaf)
+    table and pad it to the 7-row store-space form with the degenerate
+    always-in-range window (lo=0, hi1=2^30, dl=0)."""
+    if tbl.shape[0] >= 7:
+        return tbl
+    S = tbl.shape[1]
+    return jnp.concatenate([
+        tbl,
+        jnp.zeros((1, S), tbl.dtype),                       # lo
+        jnp.full((1, S), float(1 << 30), tbl.dtype),        # hi1
+        jnp.zeros((1, S), tbl.dtype)])                      # dl
+
+
 def _partition_kernel(tbl_ref, gb_ref, lid_ref, out_ref, *, S: int,
                       bin_offset: int):
-    """tbl_ref [8, S] int8 rows (f_hi, f_lo, thr-128, cat, nli-128, 0..);
-    gb_ref [1, F, Ck] int bins (int8 holds value-128 when bin_offset);
-    lid_ref/out_ref [1, Ck] int32."""
+    """tbl_ref [8, S] int8 rows (c_hi, c_lo, T-128, cat, nli-128, lo-128,
+    hi1-128, dl); gb_ref [1, F, Ck] int bins (int8 holds value-128 when
+    bin_offset); lid_ref/out_ref [1, Ck] int32."""
     lidv = lid_ref[0, :]                                     # [Ck] i32
     lid8 = (lidv - 128).astype(jnp.int8)
     iota8 = (jax.lax.broadcasted_iota(jnp.int32, (S, 1), 0)
@@ -67,6 +89,9 @@ def _partition_kernel(tbl_ref, gb_ref, lid_ref, out_ref, *, S: int,
     ti = r[2] + 128
     ci = r[3] > 0
     nli = r[4] + 128
+    lo = r[5] + 128
+    hi1 = r[6] + 128
+    dl = r[7] > 0
 
     gb = gb_ref[0]                                           # [F, Ck]
     F = gb.shape[0]
@@ -76,6 +101,7 @@ def _partition_kernel(tbl_ref, gb_ref, lid_ref, out_ref, *, S: int,
     vi = jnp.sum(jnp.where(fi[None, :] == iof, gb.astype(jnp.int32), 0),
                  axis=0) + bin_offset                        # [Ck]
     gl = jnp.where(ci, vi == ti, vi <= ti)
+    gl = jnp.where((vi >= lo) & (vi <= hi1), gl, dl)
     out_ref[0, :] = jnp.where((nli > 0) & ~gl, nli, lidv)
 
 
@@ -127,16 +153,20 @@ def partition_rows(bins_fn: jax.Array, leaf_id: jax.Array,
                    interpret: bool = False) -> jax.Array:
     """New leaf id per row after this round's splits.
 
-    bins_fn [F, N] int bins (int8 = value-128 storage); leaf_id [N]
-    int32 in [0, num_slots-1); tbl [4, num_slots] f32 rows
-    (split feature, threshold bin, is-categorical, new leaf id) indexed
-    by leaf — row values of non-splitting leaves must be 0 (new leaf 0
-    means "stay", leaf 0 is never a NEW leaf).
+    bins_fn [F, N] int STORE bins (int8 = value-128 storage); leaf_id [N]
+    int32 in [0, num_slots-1); tbl [7, num_slots] f32 rows
+    (store column, threshold T, is-categorical, new leaf id, window lo,
+    window hi inclusive, default-left) indexed by leaf — the store-space
+    predicate of ops/split.bundle_predicate_params.  The legacy [4, S]
+    layout is accepted and padded with the always-in-range window.  Row
+    values of non-splitting leaves must be 0 (new leaf 0 means "stay",
+    leaf 0 is never a NEW leaf).
 
     Routes to the fused pallas kernel when the int8 encodings are exact
-    (slots <= 256, thresholds < 256, feature ids < 2^14 i.e. two base-128
+    (slots <= 256, thresholds < 256, column ids < 2^14 i.e. two base-128
     digits); otherwise composes the XLA one-hot lookups.
     """
+    tbl = _augment_tbl(tbl)
     F = bins_fn.shape[0]
     # the kernel holds ALL F feature rows (bins + their int32 widen) per
     # block — the VMEM model must admit Ck >= 512, which bounds F at
@@ -151,9 +181,13 @@ def partition_rows(bins_fn: jax.Array, leaf_id: jax.Array,
         ti = r[1].astype(jnp.int32)
         ci = r[2] > 0
         nli = r[3].astype(jnp.int32)
+        lo = r[4].astype(jnp.int32)
+        hi1 = r[5].astype(jnp.int32)
+        dl = r[6] > 0
         off = 128 if bins_fn.dtype == jnp.int8 else 0
         vi = select_bin_by_feature(bins_fn, fi) + off
         gl = jnp.where(ci, vi == ti, vi <= ti)
+        gl = jnp.where((vi >= lo) & (vi <= hi1), gl, dl)
         return jnp.where((nli > 0) & ~gl, nli, leaf_id)
 
     S = 256 if num_slots > 128 else 128          # lane-pad the slot axis
@@ -166,9 +200,13 @@ def partition_rows(bins_fn: jax.Array, leaf_id: jax.Array,
     thr = jnp.pad(tbl[1].astype(jnp.int32), pad)
     cat = jnp.pad(tbl[2].astype(jnp.int32), pad)
     nli = jnp.pad(tbl[3].astype(jnp.int32), pad)
-    zeros = jnp.zeros_like(feat)
+    lo = jnp.pad(tbl[4].astype(jnp.int32), pad)
+    # store bins are < 256 on this path, so clamping the degenerate
+    # 2^30 window top to 255 keeps the predicate identical in int8
+    hi1 = jnp.clip(jnp.pad(tbl[5].astype(jnp.int32), pad), 0, 255)
+    dl = jnp.pad(tbl[6].astype(jnp.int32), pad)
     tbl8 = jnp.stack([feat // 128, feat % 128, thr - 128, cat, nli - 128,
-                      zeros, zeros, zeros]).astype(jnp.int8)
+                      lo - 128, hi1 - 128, dl]).astype(jnp.int8)
     N = leaf_id.shape[0]
     return _partition_pallas(tbl8, bins_fn, leaf_id, num_slots=S,
                              interpret=interpret)[:N]

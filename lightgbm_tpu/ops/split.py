@@ -57,6 +57,93 @@ class SplitResult(NamedTuple):
                           self.left_output, self.right_output])
 
 
+# ----------------------------------------------------------------------------
+# Exclusive Feature Bundling support (binning.BundlePlan device side)
+# ----------------------------------------------------------------------------
+
+def identity_feat_table(num_bins) -> "jnp.ndarray":
+    """[5, F] feat table for an UNBUNDLED store: every feature is its own
+    column, packed=0, so bundle_predicate_params degenerates to the plain
+    (feature, threshold) predicate.  Accepts host or traced num_bins."""
+    F = num_bins.shape[0] if hasattr(num_bins, "shape") else len(num_bins)
+    z = jnp.zeros(F, jnp.float32)
+    return jnp.stack([jnp.arange(F, dtype=jnp.float32), z, z,
+                      jnp.asarray(num_bins).astype(jnp.float32), z])
+
+
+def bundle_predicate_params(feat_tbl, feat, thr, is_cat):
+    """Translate an ORIGINAL-space split (feature, threshold bin, is-cat)
+    into STORE-space go-left parameters (col, T, lo, hi1, dl):
+
+        in_range = lo <= store_bin <= hi1
+        go_left  = in_range ? (is_cat ? store_bin == T : store_bin <= T)
+                            : dl
+
+    feat_tbl: [5, F] f32 rows (col, offset, default, nslots, packed) —
+    binning.BundlePlan.feat_table() or identity_feat_table().  Works for
+    scalar or vector `feat`/`thr`/`is_cat` (all traced).
+
+    Slot packing keeps bin order with the default bin removed, so a
+    numerical `orig_bin <= thr` is exactly the slot interval
+    [offset, offset + thr - (thr >= default)]; rows outside the feature's
+    slot range sit at the default bin, which goes left iff default <= thr
+    (numerical) / default == thr (categorical).  For a categorical split
+    ON the default bin, T = offset - 1 matches no in-range slot (offsets
+    start at 1) and dl sends the default rows left."""
+    feat = jnp.asarray(feat, jnp.int32)
+    thr = jnp.asarray(thr, jnp.int32)
+    feat_tbl = jnp.asarray(feat_tbl)   # may arrive as a host constant
+    col = feat_tbl[0, feat].astype(jnp.int32)
+    off = feat_tbl[1, feat].astype(jnp.int32)
+    d = feat_tbl[2, feat].astype(jnp.int32)
+    ns = feat_tbl[3, feat].astype(jnp.int32)
+    pk = feat_tbl[4, feat] > 0
+    t_num = off + thr - (thr >= d).astype(jnp.int32)
+    t_cat = jnp.where(thr == d, off - 1,
+                      off + thr - (thr > d).astype(jnp.int32))
+    T = jnp.where(pk, jnp.where(is_cat, t_cat, t_num), thr)
+    lo = jnp.where(pk, off, 0)
+    hi1 = jnp.where(pk, off + ns - 1, jnp.int32(1 << 30))
+    dl = pk & jnp.where(is_cat, thr == d, d <= thr)
+    return col, T, lo, hi1, dl
+
+
+def store_go_left(store_bin, T, lo, hi1, dl, is_cat):
+    """Evaluate the store-space predicate of bundle_predicate_params on a
+    row vector of store bins."""
+    in_r = (store_bin >= lo) & (store_bin <= hi1)
+    gl = jnp.where(is_cat, store_bin == T, store_bin <= T)
+    return jnp.where(in_r, gl, dl)
+
+
+def unbundle_hist(hist: jax.Array, src: jax.Array, dmask: jax.Array,
+                  totals: jax.Array) -> jax.Array:
+    """Bundled histogram [C, 3, B] -> original-feature histogram [F, 3, B].
+
+    src/dmask come from binning.BundlePlan.unbundle_tables: `src[f, b]`
+    is a flat index into the [C*B] store histogram (C*B = zero sentinel
+    for out-of-range bins and the default slot), and `dmask` marks each
+    packed feature's default bin, reconstructed as
+    `leaf_totals - sum(non-default bins)` — exact under zero conflicts
+    (every row of the leaf lands in exactly one bin of each feature; the
+    reference reconstructs sparse-bin zero entries the same way).
+    `totals` is the leaf's [3] (sum_grad, sum_hess, count)."""
+    C, _, B = hist.shape
+    flat = hist.transpose(0, 2, 1).reshape(C * B, 3)
+    flat = jnp.concatenate([flat, jnp.zeros((1, 3), flat.dtype)], axis=0)
+    F, Bo = src.shape
+    g = flat[src.reshape(-1)].reshape(F, Bo, 3).transpose(0, 2, 1)
+    fill = totals[None, :, None] - jnp.sum(g, axis=2, keepdims=True)
+    return jnp.where(dmask[:, None, :], fill, g)
+
+
+def maybe_unbundle(hist: jax.Array, unb, totals: jax.Array) -> jax.Array:
+    """unb is None (store is the original layout) or (src, dmask)."""
+    if unb is None:
+        return hist
+    return unbundle_hist(hist, unb[0], unb[1], totals)
+
+
 def leaf_split_gain(G, H, l1, l2):
     reg = jnp.maximum(jnp.abs(G) - l1, 0.0)
     return reg * reg / (H + l2)

@@ -1,0 +1,90 @@
+"""Fail when a `Config` field is dead: parsed and accepted but consumed
+nowhere in the package and not on the explicit not-yet-implemented
+allowlist.
+
+The bug class this guards against: `enable_bundle` / `max_conflict_rate`
+shipped in the Config dataclass for several releases while nothing read
+them — silently-accepted parameters that do nothing are worse than a
+rejection, because users believe they tuned something.  Run from the
+tier-1 suite (tests/test_config_coverage.py) and standalone:
+
+    python scripts/check_config_coverage.py
+"""
+import dataclasses
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+# Fields that are DELIBERATELY accepted-but-inert, each with the reason.
+# Adding a field here must be a conscious decision in code review — new
+# Config fields are otherwise required to be consumed somewhere.
+ALLOWLIST = {
+    # reference-compat parameters with no TPU analog
+    "num_threads": "host threading is jax/XLA's concern on this backend",
+    "is_enable_sparse": "no sparse store on TPU (SURVEY.md §7 start dense)",
+    "sparse_threshold": "no sparse store on TPU",
+    "gpu_platform_id": "OpenCL selector kept for config compatibility",
+    "gpu_device_id": "OpenCL selector kept for config compatibility",
+    "gpu_use_dp": "OpenCL precision dial; histogram_dtype is the analog",
+    "time_out": "socket-network timeout; collectives have no knob here",
+    "output_freq": "CLI logging cadence not yet wired",
+    # parsed by the CLI bootstrap before Config exists
+    "config_file": "consumed by parse_cli_args pre-Config",
+    # declared TPU knobs awaiting implementation
+    "hist_dtype": "accumulation dtype override not yet implemented",
+    "hist_input_dtype": "superseded by histogram_dtype; kept for compat",
+    "fused_tree": "forced fused builder selection not yet implemented",
+    "mesh_shape": "explicit mesh override not yet implemented",
+}
+
+
+def consumed_fields():
+    """Names referenced as a word anywhere in the package outside
+    config.py (attribute reads like cfg.max_bin, dict keys, kwargs)."""
+    blob = []
+    pkg = os.path.join(ROOT, "lightgbm_tpu")
+    for root, _dirs, files in os.walk(pkg):
+        for f in sorted(files):
+            if f.endswith(".py") and f != "config.py":
+                with open(os.path.join(root, f)) as fh:
+                    blob.append(fh.read())
+    return "\n".join(blob)
+
+
+def main() -> int:
+    from lightgbm_tpu.config import Config
+
+    blob = consumed_fields()
+    dead = []
+    stale_allow = []
+    for f in dataclasses.fields(Config):
+        used = re.search(rf"\b{re.escape(f.name)}\b", blob) is not None
+        if not used and f.name not in ALLOWLIST:
+            dead.append(f.name)
+        if used and f.name in ALLOWLIST:
+            stale_allow.append(f.name)
+    rc = 0
+    if dead:
+        rc = 1
+        print("DEAD CONFIG FIELDS (accepted but consumed nowhere; wire "
+              "them up or add to the allowlist with a reason):")
+        for name in dead:
+            print(f"  - {name}")
+    if stale_allow:
+        rc = 1
+        print("STALE ALLOWLIST ENTRIES (now consumed; remove from "
+              "scripts/check_config_coverage.py ALLOWLIST):")
+        for name in stale_allow:
+            print(f"  - {name}")
+    if rc == 0:
+        n = len(dataclasses.fields(Config))
+        print(f"config coverage OK: {n} fields, "
+              f"{len(ALLOWLIST)} allowlisted as intentionally inert")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

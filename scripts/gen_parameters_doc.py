@@ -77,6 +77,20 @@ def main():
         "`data2d` — the distributed axes map onto a `jax.sharding.Mesh` "
         "instead of socket/MPI machine lists.",
         "",
+        "## Exclusive Feature Bundling",
+        "",
+        "- `enable_bundle` (default `True`, aliases `efb`, `bundle`): "
+        "pack mutually-exclusive (mostly-default) features into shared "
+        "histogram columns, shrinking the dominant `[rows, features]` "
+        "matmul dimension of the training hot path.  Lossless when no "
+        "bundled features conflict; splits, models, and predictions "
+        "always stay in original feature space.  See `docs/Bundling.md`.",
+        "- `max_conflict_rate` (default `0.0`, alias `max_conflict`): "
+        "per-bundle tolerated fraction of rows where two members are "
+        "both non-default.  `0.0` bundles only provably exclusive "
+        "features; small values (e.g. `0.01`) trade exactness for more "
+        "compaction, like the reference's EFB.",
+        "",
     ]
     dest = os.path.join(ROOT, "docs", "Parameters.md")
     os.makedirs(os.path.dirname(dest), exist_ok=True)

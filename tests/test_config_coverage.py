@@ -1,0 +1,22 @@
+"""Tier-1 guard for the dead-config bug class (`enable_bundle` sat in
+Config unconsumed for several releases): every Config field must either
+be consumed somewhere in the package or sit on the explicit allowlist in
+scripts/check_config_coverage.py with a reason."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.quick
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_every_config_field_is_consumed_or_allowlisted():
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, "scripts", "check_config_coverage.py")],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "config coverage OK" in r.stdout
