@@ -64,12 +64,38 @@ BINS = int(os.environ.get("BENCH_BINS", 255))
 # columns, 100% exclusive; A/B with BENCH_ENABLE_BUNDLE=0/1)
 WORKLOAD = os.environ.get("BENCH_WORKLOAD", "higgs")
 ENABLE_BUNDLE = os.environ.get("BENCH_ENABLE_BUNDLE", "1") != "0"
+# row feed of the histogram passes: "" keeps the config default (auto =
+# gathered on single-device TPU, masked elsewhere); set gathered|masked
+# for the ordered-histograms A/B (docs/Readme.md "Row partition")
+HIST_ROWS = os.environ.get("BENCH_HIST_ROWS", "")
+# growth schedule override: set "rounds" to exercise the rounds learner
+# on the CPU fallback too (auto picks the exact learner off-TPU), e.g.
+# for the gathered-vs-masked CPU A/B at the reduced shape
+TREE_GROWTH = os.environ.get("BENCH_TREE_GROWTH", "")
+
+
+def _feature_fingerprint(X) -> str:
+    """Cheap content hash of a fixed row/column sample of X, folded into
+    the binned-store cache key: a generator change that alters features
+    but not labels must MISS the cache instead of silently reusing
+    stale binned data (the label check alone cannot see it)."""
+    import hashlib
+    import numpy as np
+    n, f = X.shape
+    ri = np.linspace(0, n - 1, min(n, 64)).astype(np.int64)
+    ci = np.linspace(0, f - 1, min(f, 64)).astype(np.int64)
+    # index BEFORE any dtype conversion: a full float64 copy of X would
+    # be ~62 GB at the Expo shape, on every call incl. cache hits
+    sample = np.ascontiguousarray(
+        np.asarray(X)[np.ix_(ri, ci)].astype(np.float64))
+    return hashlib.sha1(sample.tobytes()).hexdigest()[:10]
 
 
 def binned_dataset(tag, X, y, params, categorical_feature="auto",
                    group=None):
     """lgb.Dataset for (X, y) backed by a binned-store cache keyed by
-    tag/shape/max_bin (.bench/<tag>_binned_<N>x<F>_b<bins>.bin).
+    tag/shape/max_bin/feature-fingerprint
+    (.bench/<tag>_binned_<N>x<F>_b<bins>_<fp>.bin).
 
     Host binning at benchmark shapes costs minutes (Epsilon 400k x 2000:
     ~113 s; Expo 11M x 700: ~25 min) — cached, a chip window spends that
@@ -81,8 +107,10 @@ def binned_dataset(tag, X, y, params, categorical_feature="auto",
 
     root = os.path.dirname(os.path.abspath(__file__))
     mb = int(params.get("max_bin", 255))
+    fp = _feature_fingerprint(X)
     cache = os.path.join(
-        root, ".bench", f"{tag}_binned_{len(y)}x{X.shape[1]}_b{mb}.bin")
+        root, ".bench",
+        f"{tag}_binned_{len(y)}x{X.shape[1]}_b{mb}_{fp}.bin")
     if os.path.exists(cache):
         from lightgbm_tpu.capi import _wrap_inner
         from lightgbm_tpu.config import config_from_params
@@ -179,6 +207,10 @@ def main():
         # single-precision trade, docs/GPU-Performance.md:130-134)
         "histogram_dtype": HIST_DTYPE,
     }
+    if HIST_ROWS:
+        params["hist_rows"] = HIST_ROWS
+    if TREE_GROWTH:
+        params["tree_growth"] = TREE_GROWTH
     cache_tag = WORKLOAD if ENABLE_BUNDLE else f"{WORKLOAD}_nobundle"
     train = binned_dataset(cache_tag, X, y, params)
     bst = lgb.Booster(params, train)
@@ -203,6 +235,8 @@ def main():
     for _ in range(WARMUP - 1):      # compile + cache warm
         bst.update()
     float(bst._gbdt.train_score.score.sum())   # drain warmup in-flight work
+    from lightgbm_tpu import profiling
+    rows_t0 = profiling.counter_value("tree/hist_rows_touched")
     t0 = time.perf_counter()
     for _ in range(ITERS):
         bst.update()
@@ -212,6 +246,10 @@ def main():
     float(bst._gbdt.train_score.score.sum())
     dt = time.perf_counter() - t0
     s_per_iter = dt / ITERS
+    # histogram-kernel row traffic over the same window (the live-rows
+    # metric of the gathered-vs-masked A/B; 0 for non-rounds learners)
+    rows_per_iter = (profiling.counter_value("tree/hist_rows_touched")
+                     - rows_t0) / ITERS
 
     root = os.path.dirname(os.path.abspath(__file__))
     vs = 0.0
@@ -258,6 +296,10 @@ def main():
         "value": round(s_per_iter, 4),
         "unit": "s/iter",
         "vs_baseline": round(vs, 4),
+        # the row feed that ACTUALLY ran (auto resolves per topology)
+        # and its measured histogram row traffic
+        "hist_rows": getattr(bst._gbdt.learner, "hist_rows", "n/a"),
+        "rows_touched_per_iter": round(rows_per_iter, 1),
         "kernel_flags": {
             "narrow_onehot": bool(_h.NARROW_ONEHOT),
             "fused_partition": bool(_p.FUSED_PARTITION),

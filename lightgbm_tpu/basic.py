@@ -494,7 +494,8 @@ class Booster:
                 "use 'split' or 'gain'")
         imp = self._gbdt.feature_importance(importance_type)
         names = self.feature_name()
-        dt = np.float64 if importance_type == "gain" else np.int64
+        # split importance is int32 in the reference C API (int* out)
+        dt = np.float64 if importance_type == "gain" else np.int32
         return np.array([imp.get(n, 0) for n in names], dt)
 
     def feature_name(self) -> List[str]:

@@ -112,6 +112,9 @@ PARAM_ALIASES: Dict[str, str] = {
     "is_enable_bundle": "enable_bundle",
     "max_conflict": "max_conflict_rate",
     "bundle_conflict_rate": "max_conflict_rate",
+    # row partition / ordered histograms (docs/Readme.md)
+    "ordered_histograms": "hist_rows",
+    "row_partition": "hist_rows",
 }
 
 # objective name aliases (reference config.cpp GetObjectiveType handling)
@@ -270,6 +273,15 @@ class Config:
     # bfloat16 (fast).  The reference GPU learner has the same dial as
     # gpu_use_dp (config.h:206, single vs double) with single the default.
     histogram_dtype: str = "float32"
+    # row feed of the batched-rounds histogram passes: "masked" streams
+    # the full [F, N] bin store every pass; "gathered" keeps a
+    # device-resident row partition (the reference's DataPartition +
+    # ordered-gradients design, data_partition.hpp) and histograms only
+    # the leaf-contiguous segments each round needs — bagged/GOSS-dropped
+    # rows never enter the permutation.  "auto" = gathered on
+    # single-device TPU, masked elsewhere (shard-map stays masked until
+    # per-shard local compaction lands).
+    hist_rows: str = "auto"
 
     # -- network (config.h:245-252)
     num_machines: int = 1
@@ -399,6 +411,8 @@ def check_param_conflict(cfg: Config) -> None:
         raise ValueError(f"unknown tree_learner: {cfg.tree_learner}")
     if cfg.tree_growth not in ("auto", "exact", "rounds"):
         raise ValueError(f"unknown tree_growth: {cfg.tree_growth}")
+    if cfg.hist_rows not in ("auto", "gathered", "masked"):
+        raise ValueError(f"unknown hist_rows: {cfg.hist_rows}")
     if not (0 <= cfg.serve_port <= 65535):
         raise ValueError("serve_port must be in [0, 65535]")
     if cfg.max_batch_rows < 1:

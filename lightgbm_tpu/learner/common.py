@@ -74,6 +74,19 @@ class MultiHostRows:
                               axis=-1)[..., : self.n_local]
 
 
+def compat_shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """jax.shard_map with a fallback to the pre-graduation API
+    (jax<=0.5 ships it as jax.experimental.shard_map.shard_map, with
+    the replication-check flag named check_rep instead of check_vma)."""
+    import jax
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map
+    return shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     check_rep=check_vma)
+
+
 def make_split_kw(cfg: Config) -> tuple:
     """Hashable (static-arg) split hyperparameters for ops.split.best_split
     (reference feature_histogram.hpp:281-300 gain math inputs)."""
@@ -122,6 +135,82 @@ def _default_pool_budget() -> float:
     except Exception:
         pass
     return 4e9 if on_tpu else 1.5e9
+
+
+def gather_scratch_capacity(np_rows: int) -> int:
+    """Static row capacity of the gathered-histogram scratch for the
+    smaller-child passes: in any round the smaller children of all
+    splits partition subsets of their parents, so their sizes sum to
+    <= ceil(N/2) by construction (the same bound that makes the
+    reference's smaller/larger subtraction trick work,
+    serial_tree_learner.cpp:344-422).  128-aligned so every tier is a
+    whole lane tile."""
+    cap = (np_rows + 1) // 2
+    return max(128, 128 * int(math.ceil(cap / 128)))
+
+
+def gather_capacity_tiers(cap: int) -> tuple:
+    """Ascending static capacities for the gathered passes (full, /4,
+    /16 of `cap`, deduped).  The per-pass capacity is picked at run time
+    as the smallest tier holding the round's live rows — late rounds
+    with small leaves drop to the small tiers, so the kernel cost
+    tracks the live-row count instead of the static bound.  Three tiers
+    bound the compile count (each tier is one kernel specialization,
+    shared across call sites by the jit cache)."""
+    full = max(128, 128 * int(math.ceil(cap / 128)))
+    tiers = {full}
+    for d in (4, 16):
+        tiers.add(max(128, 128 * ((cap // d) // 128)))
+    return tuple(sorted(tiers))
+
+
+def gathered_scratch_fits(num_columns: int, np_rows: int,
+                          bins_itemsize: int = 4,
+                          limit_bytes: float = 0.0) -> bool:
+    """Budget gate for the gathered path's transient scratch (the
+    [F, cap] gathered bins plus [8, cap] vals materialized per pass —
+    the analog of the HistogramPool cap for this buffer): it must fit
+    comfortably next to the bin store and scores, so refuse when it
+    would exceed ~15% of device memory."""
+    cap = gather_scratch_capacity(np_rows)
+    scratch = float(cap) * (num_columns * bins_itemsize + 8 * 4)
+    if limit_bytes <= 0:
+        try:
+            import jax
+            stats = jax.local_devices()[0].memory_stats()
+            limit_bytes = float((stats or {}).get("bytes_limit", 0)) or 16e9
+        except Exception:
+            limit_bytes = 16e9
+    return scratch <= 0.15 * limit_bytes
+
+
+def resolve_hist_rows(cfg: Config, *, backend: str, data_parallel: bool,
+                      num_columns: int, np_rows: int,
+                      bins_itemsize: int = 4) -> str:
+    """Resolve the `hist_rows` knob to the mode a rounds learner runs.
+
+    "masked" streams the full [F, N] bin store every histogram pass;
+    "gathered" maintains the device-resident row partition and feeds
+    the kernels only the leaf-contiguous segments they need.  "auto"
+    picks gathered on single-device TPU (the bandwidth-bound regime the
+    optimization targets) and masked elsewhere: masked remains the
+    shard-map path until per-shard local compaction lands, and the CPU
+    tier keeps its committed masked behavior unless opted in."""
+    mode = getattr(cfg, "hist_rows", "auto")
+    from .. import log
+    if data_parallel:
+        if mode == "gathered":
+            log.warning("hist_rows=gathered is not shard-map aware yet; "
+                        "using masked for data-parallel training")
+        return "masked"
+    if mode == "auto":
+        mode = "gathered" if backend == "pallas" else "masked"
+    if mode == "gathered" and not gathered_scratch_fits(
+            num_columns, np_rows, bins_itemsize):
+        log.warning("hist_rows=gathered scratch would not fit the device "
+                    "memory budget at this shape; using masked")
+        return "masked"
+    return mode
 
 
 def use_parent_hist_cache(cfg: Config, num_features: int,

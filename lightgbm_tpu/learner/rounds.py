@@ -39,10 +39,11 @@ import numpy as np
 
 from ..config import Config
 from ..dataset import Dataset
-from .common import (make_split_kw, padded_bin_count, sentinel_bins_t,
-                     use_parent_hist_cache)
+from .common import (gather_capacity_tiers, gather_scratch_capacity,
+                     make_split_kw, padded_bin_count, resolve_hist_rows,
+                     sentinel_bins_t, use_parent_hist_cache)
 from .fused import TreeArrays, tree_arrays_to_host
-from ..ops.histogram import hist_multileaf_masked
+from ..ops.histogram import hist_multileaf_gathered, hist_multileaf_masked
 from ..ops.partition import partition_rows
 from ..ops.split import (best_split, bundle_predicate_params,
                          identity_feat_table, leaf_output, maybe_unbundle)
@@ -117,9 +118,24 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
                       input_dtype: str = "float32",
                       max_rounds: int = 0,
                       cache_parent_hist: bool = True,
+                      hist_rows: str = "masked",
                       leaves_per_batch: int = 0):
     """Grow one tree in batched rounds.  Shapes as learner/fused.build_tree.
-    Returns (TreeArrays, leaf_id).
+    Returns (TreeArrays, leaf_id, rows_touched) — rows_touched is the
+    f32 count of rows processed by histogram kernels for this tree (the
+    live-traffic metric behind the gathered-vs-masked A/B).
+
+    hist_rows="gathered" (static; single-device only — callers resolve
+    via common.resolve_hist_rows) maintains a device-resident row
+    partition inside the while_loop: a [N] row permutation grouped by
+    leaf plus per-leaf (offset, count), stably compacted after each
+    round's partition_rows exactly like the reference's
+    DataPartition::Split (data_partition.hpp:80-130).  Histogram passes
+    then gather only the leaf-contiguous segments they need into a
+    static scratch (sum of smaller children <= N/2 by construction)
+    instead of streaming all N rows; bagged/GOSS-dropped rows never
+    enter the permutation.  "masked" is the original full-stream path
+    and remains what shard-map runs.
 
     `bins` holds STORE columns (bundled under EFB); num_bins/is_cat/fmask
     are per-ORIGINAL-feature.  `ftbl` is the [5, F] feature→column table
@@ -140,6 +156,12 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
     B = num_bins_padded
     K = leaves_per_batch or LEAVES_PER_BATCH
     n_chunks = (L + K - 1) // K
+    gathered = hist_rows == "gathered" and data_axis is None
+    if gathered:
+        # static capacity tiers: smaller-child passes are bounded by
+        # ceil(N/2); direct large-child passes (bounded-memory mode) by N
+        tiers_small = gather_capacity_tiers(gather_scratch_capacity(Nloc))
+        tiers_all = gather_capacity_tiers(Nloc)
     if ftbl is None:
         ftbl = identity_feat_table(num_bins)
     # Termination is governed by the while_loop predicate (no positive gain
@@ -189,6 +211,24 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
     root_sums = jnp.stack([sum_g, sum_h, cnt])
 
     leaf_id = jnp.zeros(Nloc, jnp.int32)
+    if gathered:
+        # initial permutation: live (mask > 0) rows first in row order —
+        # root's segment — with sampled-out rows parked past n_active,
+        # outside every leaf segment forever (they still carry leaf ids
+        # and are moved by partition_rows, but no histogram reads them)
+        posn0 = jax.lax.iota(jnp.int32, Nloc)
+        live0 = (row_mask > 0).astype(jnp.int32)
+        ecs0 = jnp.cumsum(live0) - live0           # lives before each row
+        n_active = jnp.sum(live0)
+        dest0 = jnp.where(live0 > 0, ecs0, n_active + (posn0 - ecs0))
+        perm = jnp.zeros(Nloc, jnp.int32).at[dest0].set(posn0)
+        leaf_off = jnp.zeros(L, jnp.int32)
+        leaf_cnt = jnp.zeros(L, jnp.int32).at[0].set(n_active)
+    else:
+        perm = jnp.zeros(0, jnp.int32)
+        leaf_off = jnp.zeros(0, jnp.int32)
+        leaf_cnt = jnp.zeros(0, jnp.int32)
+    rows_touched = jnp.float32(Nloc)               # the masked root pass
     leaf_best = jnp.full((L, 11), NEG_INF, jnp.float32).at[0].set(
         find_best_batch(hist0[None], root_sums[None])[0])
     leaf_depth = jnp.zeros(L, jnp.int32)
@@ -218,7 +258,7 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
 
     def round_body(st):
         (rnd, leaf_id, leaf_best, leaf_depth, leaf_parent, leaf_side,
-         leaf_hist, arrs) = st
+         leaf_hist, perm, leaf_off, leaf_cnt, rows_touched, arrs) = st
         n_leaves = arrs.num_leaves
 
         # ---- select this round's splits (top-gain within the cap) ---------
@@ -266,6 +306,43 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
                          srow(lov), srow(hi1v), srow(dlv)])
         leaf_id2 = partition_rows(binsf, leaf_id, tbl, num_slots=L + 1,
                                   backend=backend, num_bins_padded=B)
+
+        # ---- stable row compaction (DataPartition::Split, vectorized) -----
+        # Each splitting leaf's contiguous segment of `perm` divides into
+        # a stay-prefix (rows keeping the parent id, original order) and
+        # a moved-suffix (rows taking the new id) — O(N) with one cumsum
+        # and a scatter, no sort.  Parked (sampled-out) rows sit past
+        # n_active and keep their positions.
+        if gathered:
+            posn = jax.lax.iota(jnp.int32, Nloc)
+            n_act = jnp.sum(leaf_cnt)
+            ol = jnp.take(leaf_id, perm)                 # old leaf per slot
+            nl = jnp.take(leaf_id2, perm)                # new leaf per slot
+            stay = nl == ol
+            csp = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                                   jnp.cumsum(stay.astype(jnp.int32))])
+            soff = jnp.take(leaf_off, ol)                # segment starts
+            seg_stays = jnp.take(csp, soff)
+            rstay = csp[:Nloc] - seg_stays               # stays before pos
+            ns_row = jnp.take(csp, soff + jnp.take(leaf_cnt, ol)) - seg_stays
+            dest = soff + jnp.where(stay, rstay,
+                                    ns_row + (posn - soff) - rstay)
+            dest = jnp.where(posn >= n_act, posn, dest)
+            perm2 = jnp.zeros_like(perm).at[dest].set(perm)
+            # split each parent's (offset, count): parent keeps the
+            # stay-prefix, the new leaf takes the moved suffix
+            ns_leaf = (jnp.take(csp, leaf_off + leaf_cnt)
+                       - jnp.take(csp, leaf_off))        # [L] stay counts
+            ns_p = jnp.take(ns_leaf, pl_)
+            nii = jnp.where(do, new_leaf, L)
+            pii = jnp.where(do, pl_, L)
+            leaf_off2 = leaf_off.at[nii].set(
+                jnp.take(leaf_off, pl_) + ns_p, mode="drop")
+            leaf_cnt2 = (leaf_cnt.at[nii].set(
+                jnp.take(leaf_cnt, pl_) - ns_p, mode="drop")
+                .at[pii].set(ns_p, mode="drop"))
+        else:
+            perm2, leaf_off2, leaf_cnt2 = perm, leaf_off, leaf_cnt
 
         # ---- tree arrays (batched Tree::Split) ----------------------------
         nodei = jnp.where(do, node, L - 1)                   # drop idx
@@ -357,8 +434,40 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
             return jax.lax.cond(~jnp.any(dk[K_SMALL:]),
                                 lambda _: at(K_SMALL), full_or_mid, None)
 
+        def hist_gathered_tiered(slv, tiers):
+            """Gathered histogram of the slots' leaf segments at the
+            smallest static capacity tier holding this pass's live rows
+            (lax.cond picks the tier at run time; every tier is one
+            fixed-shape kernel, so nothing retraces round to round).
+            Returns ([Kc, F, 3, B] hists, f32 rows processed)."""
+            sc = jnp.clip(slv, 0, L - 1)
+            act = slv >= 0
+            so = jnp.where(act, jnp.take(leaf_off2, sc), 0)
+            sn = jnp.where(act, jnp.take(leaf_cnt2, sc), 0)
+            total = jnp.sum(sn)
+
+            def call(cap):
+                def f(_):
+                    return hist_multileaf_gathered(
+                        binsf, gh8, perm2, so, sn, capacity=cap,
+                        num_bins_padded=B, backend=backend,
+                        input_dtype=input_dtype, max_num_bin=max_num_bin)
+                return f
+
+            def pick(i):
+                if i == len(tiers) - 1:
+                    return call(tiers[i])
+                return lambda _: jax.lax.cond(
+                    total <= tiers[i], call(tiers[i]), pick(i + 1), None)
+
+            rt_pass = jnp.float32(tiers[-1])
+            for cap in tiers[-2::-1]:
+                rt_pass = jnp.where(total <= cap, jnp.float32(cap), rt_pass)
+            return pick(0)(None), rt_pass
+
         leaf_best2 = leaf_best
         leaf_hist2 = leaf_hist
+        rows2 = rows_touched
         for c in range(n_chunks):
             s = c * K
             Kc = min(K, L - s)                               # last chunk short
@@ -366,15 +475,26 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
             sl = small_leaf[s:s + Kc]
 
             def do_chunk(args, s=s, Kc=Kc, dk=dk, sl=sl):
-                leaf_best2, leaf_hist2 = args
+                leaf_best2, leaf_hist2, rt = args
                 slv = jnp.where(dk, sl, -1)                  # -1 = empty slot
-                h_small = hist_tiered(slv, dk, Kc)
+                if gathered:
+                    h_small, rtp = hist_gathered_tiered(slv, tiers_small)
+                    rt = rt + rtp
+                else:
+                    h_small = hist_tiered(slv, dk, Kc)
+                    rt = rt + jnp.float32(Nloc)
                 h_small = _psum(h_small, data_axis)          # [Kc, F, 3, B]
                 if cache_parent_hist:
                     h_large = leaf_hist2[pl_[s:s + Kc]] - h_small
                 else:
                     llv = jnp.where(dk, large_leaf[s:s + Kc], -1)
-                    h_large = _psum(hist_tiered(llv, dk, Kc), data_axis)
+                    if gathered:
+                        h_large, rtp = hist_gathered_tiered(llv, tiers_all)
+                        rt = rt + rtp
+                    else:
+                        h_large = hist_tiered(llv, dk, Kc)
+                        rt = rt + jnp.float32(Nloc)
+                    h_large = _psum(h_large, data_axis)
                 rec_s = find_best_batch(h_small, small_sums[s:s + Kc])
                 rec_l = find_best_batch(h_large, large_sums[s:s + Kc])
                 sil = small_is_left[s:s + Kc, None]
@@ -391,28 +511,31 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
                         hR, mode="drop")
                 else:
                     lh = leaf_hist2
-                return lb, lh
+                return lb, lh, rt
 
             def skip_chunk(args):
                 return args
 
-            leaf_best2, leaf_hist2 = jax.lax.cond(
-                jnp.any(dk), do_chunk, skip_chunk, (leaf_best2, leaf_hist2))
+            leaf_best2, leaf_hist2, rows2 = jax.lax.cond(
+                jnp.any(dk), do_chunk, skip_chunk,
+                (leaf_best2, leaf_hist2, rows2))
 
         return (rnd + 1, leaf_id2, leaf_best2, leaf_depth2, leaf_parent2,
-                leaf_side2, leaf_hist2, arrs2)
+                leaf_side2, leaf_hist2, perm2, leaf_off2, leaf_cnt2,
+                rows2, arrs2)
 
     def round_cond(st):
-        rnd, _, leaf_best, leaf_depth, _, _, _, arrs = st
+        rnd, leaf_best, leaf_depth, arrs = st[0], st[2], st[3], st[-1]
         gated = jnp.where((max_depth <= 0) | (leaf_depth < max_depth),
                           leaf_best[:, 0], NEG_INF)
         return ((rnd < R) & (arrs.num_leaves < L)
                 & jnp.any(gated > 0))
 
     st = (jnp.int32(0), leaf_id, leaf_best, leaf_depth, leaf_parent,
-          leaf_side, leaf_hist, arrs)
+          leaf_side, leaf_hist, perm, leaf_off, leaf_cnt, rows_touched,
+          arrs)
     st = jax.lax.while_loop(round_cond, round_body, st)
-    return st[-1], st[1]
+    return st[-1], st[1], _psum(st[-2], data_axis)
 
 
 class RoundsTreeLearner:
@@ -506,6 +629,12 @@ class RoundsTreeLearner:
         # column count is this shard's local share of the STORE
         self.cache_parent_hist = use_parent_hist_cache(cfg, self.Fpad,
                                                        self.B)
+        # row feed: gathered (ordered histograms over the device-resident
+        # row partition) vs masked full-stream — see build_tree_rounds
+        self.hist_rows = resolve_hist_rows(
+            cfg, backend=backend, data_parallel=self.dd > 1,
+            num_columns=self.Fpad, np_rows=self._local_np,
+            bins_itemsize=int(bins_np.dtype.itemsize))
         kw = dict(num_leaves=cfg.num_leaves, num_bins_padded=self.B,
                   max_num_bin=int(dataset.max_num_bin),
                   split_kw=self.split_kw, max_depth=int(cfg.max_depth),
@@ -513,6 +642,7 @@ class RoundsTreeLearner:
                   min_sum_hessian_in_leaf=float(cfg.min_sum_hessian_in_leaf),
                   backend=backend,
                   cache_parent_hist=self.cache_parent_hist,
+                  hist_rows=self.hist_rows,
                   ftbl=ftbl, unb=unb,
                   input_dtype=getattr(cfg, "histogram_dtype", "float32"))
         if mesh is None:
@@ -525,8 +655,9 @@ class RoundsTreeLearner:
             da = "data" if self.dd > 1 else None
             in_specs = (P(None, da), P(da), P(da), P(da), P(), P(), P())
             out_specs = (jax.tree_util.tree_map(lambda _: P(), TreeArrays(
-                *[0] * len(TreeArrays._fields))), P(da))
-            self._build = jax.jit(jax.shard_map(
+                *[0] * len(TreeArrays._fields))), P(da), P())
+            from .common import compat_shard_map
+            self._build = jax.jit(compat_shard_map(
                 fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                 check_vma=False))
             if self.mh is not None:
@@ -622,19 +753,25 @@ class RoundsTreeLearner:
         with NO device→host sync — callers pipeline the tree fetch and can
         score valid sets straight from the device TreeArrays."""
         from .fused import pack_tree_arrays
+        from .. import profiling
         mask, fmask = self._masks(bag_idx)
-        arrs, leaf_id = self._build(
+        arrs, leaf_id, rows_t = self._build(
             self.bins_dev, self._pad_rows(grad), self._pad_rows(hess), mask,
             self.num_bins_dev, self.is_cat_dev, fmask)
+        # device scalar, folded into the counter at the next metrics
+        # read — no sync on the pipelined path
+        profiling.count_deferred("tree/hist_rows_touched", rows_t)
         return pack_tree_arrays(arrs), leaf_id[: self.N], arrs
 
     def train(self, grad: jax.Array, hess: jax.Array,
               bag_idx: Optional[jax.Array] = None,
               bag_count: Optional[int] = None) -> Tuple[Tree, jax.Array]:
+        from .. import profiling
         mask, fmask = self._masks(bag_idx)
-        arrs, leaf_id = self._build(
+        arrs, leaf_id, rows_t = self._build(
             self.bins_dev, self._pad_rows(grad), self._pad_rows(hess), mask,
             self.num_bins_dev, self.is_cat_dev, fmask)
+        profiling.count_deferred("tree/hist_rows_touched", rows_t)
         tree = tree_arrays_to_host(arrs, self.dataset, self.config.num_leaves)
         if self.mh is not None:
             return tree, jnp.asarray(self.mh.local_rows(leaf_id))

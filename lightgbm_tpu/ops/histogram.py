@@ -134,9 +134,12 @@ def _gather_chunk_cap(B: int, itemsize: int = 4) -> int:
     """VMEM self-cap for the gather-fed kernels' one-hot transient
     ([Ck, B] in the compute dtype): LGBT_HIST_CHUNK drives both chunk
     globals, so a masked-kernel sweep value (e.g. 16384) must not hand
-    these kernels a ~16 MB f32 transient.  Budget 4 MB, 128-aligned."""
+    these kernels a ~16 MB f32 transient.  Budget 4 MB, 128-aligned.
+    The floor is one 128-lane tile — a 512-row floor would let padded
+    B >= 2048 blow the stated budget (512*2048*4 = 4.2 MB+); this cap
+    model also sizes the gathered-segment kernel's scratch chunks."""
     cap = int(4e6) // (itemsize * max(B, 1))
-    return max(512, (cap // 128) * 128)
+    return max(128, (cap // 128) * 128)
 
 # Narrow-dtype one-hot compare in the masked kernels (int8/bf16 instead
 # of int32 — see _packed_onehot).  Kill-switch for on-chip A/B.
@@ -826,6 +829,85 @@ def histogram_from_indices(bins_t: jax.Array, grad_pad: jax.Array,
     vals = jnp.stack([g, h, mask])                      # [3, C]
     return hist_xla(gb.astype(jnp.int32), vals,
                     num_bins_padded=num_bins_padded, input_dtype=input_dtype)
+
+
+def gather_segments(perm: jax.Array, seg_off: jax.Array,
+                    seg_cnt: jax.Array, *, capacity: int):
+    """Concatenate K contiguous segments of the row permutation `perm`
+    into one static scratch layout (the reference's ordered-gradients
+    read: DataPartition keeps each leaf's rows contiguous and the
+    histogram kernel walks exactly that span,
+    data_partition.hpp:80-130).
+
+    perm : [N] int32 row permutation (rows grouped by leaf).
+    seg_off, seg_cnt : [K] int32 — segment start/length per slot inside
+        `perm` (cnt 0 = empty slot).
+    capacity : static scratch length; must satisfy sum(seg_cnt) <=
+        capacity (callers size it from the N/2 smaller-child bound).
+
+    Returns (idx [capacity] int32 row ids — clamped-but-arbitrary for
+    unused scratch slots, slot [capacity] int32 slot id per scratch
+    position with -2 marking unused slots, total int32 scalar).
+    """
+    K = seg_off.shape[0]
+    base = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                            jnp.cumsum(seg_cnt.astype(jnp.int32))])  # [K+1]
+    total = base[K]
+    j = jax.lax.iota(jnp.int32, capacity)
+    # scratch position j belongs to the slot whose cumulative span
+    # contains it; empty slots span nothing and are never selected
+    slot = jnp.searchsorted(base[1:], j, side="right").astype(jnp.int32)
+    valid = j < total
+    sc = jnp.minimum(slot, K - 1)
+    pos = seg_off[sc] + (j - base[sc])
+    pos = jnp.clip(pos, 0, perm.shape[0] - 1)
+    idx = jnp.take(perm, pos)
+    return idx, jnp.where(valid, sc, -2), total
+
+
+def hist_multileaf_gathered(bins_fn: jax.Array, gh8: jax.Array,
+                            perm: jax.Array, seg_off: jax.Array,
+                            seg_cnt: jax.Array, *, capacity: int,
+                            num_bins_padded: int, backend: str = "xla",
+                            input_dtype: str = "float32",
+                            interpret: bool = False,
+                            max_num_bin: int = 0) -> jax.Array:
+    """Histogram K leaf-contiguous row segments in one pass over a
+    static [capacity] scratch — the "ordered" alternative to
+    hist_multileaf_masked that touches only the rows the round needs
+    instead of streaming all N.
+
+    bins_fn : [F, N] int bins (int8 = value-128 storage, kept narrow
+        through the gather); gh8 : [8, N] f32 (grad·rm, hess·rm, rm,
+        pads); perm/seg_off/seg_cnt as gather_segments.
+
+    Returns [K, F, 3, B] f32 — slot k holds segment k's histogram
+    (exactly hist_multileaf_masked's output for the same leaf when the
+    segment contains that leaf's live rows; empty slots are zero).
+
+    The heavy lifting reuses the masked kernel pair (incl. the int8
+    one-hot Pallas path) on the compacted rows: scratch slot ids play
+    the leaf-id role, so nothing about the VMEM mask-building or the
+    quantized int32 accumulation changes — only C collapses from N to
+    `capacity`.  `capacity` is static, so repeated calls at the same
+    tier never retrace.  On the int8 path the per-pass quantization
+    scales derive from the gathered rows only (a tighter bound than the
+    masked kernel's all-rows max — strictly less rounding error)."""
+    K = seg_off.shape[0]
+    idx, slot, _ = gather_segments(perm, seg_off, seg_cnt,
+                                   capacity=capacity)
+    gbg = jnp.take(bins_fn, idx, axis=1)             # [F, capacity]
+    live = (slot >= 0)
+    ghg = jnp.take(gh8, idx, axis=1) * live[None, :].astype(jnp.float32)
+    sl = jax.lax.iota(jnp.int32, K)
+    # the in-kernel "leaf" ids are the slot ids, so the narrow-compare
+    # gate is the slot count (exclusive bound on every live lid)
+    return hist_multileaf_masked(gbg, slot, ghg, sl,
+                                 num_bins_padded=num_bins_padded,
+                                 backend=backend, input_dtype=input_dtype,
+                                 interpret=interpret,
+                                 max_num_bin=max_num_bin,
+                                 num_leaves=K if K <= 255 else 0)
 
 
 def histogram_full_masked(bins: jax.Array, grad: jax.Array, hess: jax.Array,

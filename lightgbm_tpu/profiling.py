@@ -30,6 +30,10 @@ _lock = threading.Lock()
 _counters: Dict[str, float] = defaultdict(float)
 _samples: Dict[str, Deque[float]] = {}
 _SAMPLE_CAP = 4096
+# one pending device scalar per name (count_deferred accumulates
+# DEVICE-side, so an arbitrarily long training run holds exactly one
+# live buffer per counter), folded into _counters on read
+_deferred: Dict[str, object] = {}
 
 
 @contextmanager
@@ -61,13 +65,37 @@ def count(name: str, inc: float = 1.0) -> None:
         _counters[name] += inc
 
 
+def count_deferred(name: str, value) -> None:
+    """Accumulate a DEVICE scalar against a counter without forcing a
+    host sync (the pipelined trainer must not stall on a metrics fetch
+    — the device→host transfer that motivates
+    _train_one_iter_pipelined).  Accumulation happens device-side (`+`
+    dispatches asynchronously), so only one buffer per name stays live;
+    the total is converted and folded into the counter on the next
+    counter_value()/counters() read, where the caller has chosen to pay
+    the sync."""
+    with _lock:
+        prev = _deferred.get(name)
+        _deferred[name] = value if prev is None else prev + value
+
+
+def _drain_deferred_locked() -> None:
+    """Fold pending device totals into _counters; caller holds _lock.
+    float() on a jax array blocks until the value is ready."""
+    for name, val in list(_deferred.items()):
+        _counters[name] += float(val)
+        del _deferred[name]
+
+
 def counter_value(name: str) -> float:
     with _lock:
+        _drain_deferred_locked()
         return _counters.get(name, 0.0)
 
 
 def counters(prefix: str = "") -> Dict[str, float]:
     with _lock:
+        _drain_deferred_locked()
         return {k: v for k, v in _counters.items() if k.startswith(prefix)}
 
 
@@ -119,6 +147,7 @@ def reset() -> None:
         _counts.clear()
         _counters.clear()
         _samples.clear()
+        _deferred.clear()
 
 
 if ENABLED:

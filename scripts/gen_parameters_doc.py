@@ -76,6 +76,18 @@ def main():
         "- `tree_learner`: `serial` | `feature` | `data` | `voting` | "
         "`data2d` — the distributed axes map onto a `jax.sharding.Mesh` "
         "instead of socket/MPI machine lists.",
+        "- `hist_rows` (default `auto`, aliases `ordered_histograms`, "
+        "`row_partition`): row feed of the batched-rounds histogram "
+        "passes. `masked` streams the full `[features, rows]` bin store "
+        "every pass; `gathered` maintains a device-resident row "
+        "partition (a row permutation grouped by leaf plus per-leaf "
+        "offset/count — the reference's `DataPartition` + ordered-"
+        "gradients design) and histograms only the leaf-contiguous "
+        "segments each round needs, so bagged/GOSS-dropped rows are "
+        "never read. `auto` = gathered on single-device TPU, masked "
+        "elsewhere (data-parallel shard-map stays masked until "
+        "per-shard local compaction lands). See docs/Readme.md "
+        "\"Row partition / ordered histograms\".",
         "",
         "## Exclusive Feature Bundling",
         "",

@@ -169,7 +169,7 @@ def test_int8_stored_bins_grow_identical_trees():
     common = (jnp.asarray(grad), jnp.asarray(hess),
               jnp.ones(len(y), jnp.float32))
 
-    arrs32, lid32 = build_tree_rounds(
+    arrs32, lid32, _ = build_tree_rounds(
         jnp.asarray(bins), *common, jnp.asarray(nb),
         jnp.zeros(F, bool), jnp.ones(F, bool), **kw)
 
@@ -180,7 +180,7 @@ def test_int8_stored_bins_grow_identical_trees():
                    ((0, Fpad - F), (0, 0)), constant_values=-128)
     nb8 = np.pad(nb, (0, Fpad - F), constant_values=1)
     fmask8 = np.pad(np.ones(F, bool), (0, Fpad - F))
-    arrs8, lid8 = build_tree_rounds(
+    arrs8, lid8, _ = build_tree_rounds(
         jnp.asarray(bins8), *common, jnp.asarray(nb8),
         jnp.zeros(Fpad, bool), jnp.asarray(fmask8), **kw)
 
