@@ -72,6 +72,10 @@ HIST_ROWS = os.environ.get("BENCH_HIST_ROWS", "")
 # on the CPU fallback too (auto picks the exact learner off-TPU), e.g.
 # for the gathered-vs-masked CPU A/B at the reduced shape
 TREE_GROWTH = os.environ.get("BENCH_TREE_GROWTH", "")
+# data-parallel histogram exchange override: "" keeps the config default
+# (auto = psum_scatter at large payloads); set psum|psum_scatter for the
+# comms A/B on multi-device runs (docs/Readme.md "Histogram exchange")
+HIST_EXCHANGE = os.environ.get("BENCH_HIST_EXCHANGE", "")
 
 
 def _feature_fingerprint(X) -> str:
@@ -211,6 +215,8 @@ def main():
         params["hist_rows"] = HIST_ROWS
     if TREE_GROWTH:
         params["tree_growth"] = TREE_GROWTH
+    if HIST_EXCHANGE:
+        params["hist_exchange"] = HIST_EXCHANGE
     cache_tag = WORKLOAD if ENABLE_BUNDLE else f"{WORKLOAD}_nobundle"
     train = binned_dataset(cache_tag, X, y, params)
     bst = lgb.Booster(params, train)
@@ -236,7 +242,9 @@ def main():
         bst.update()
     float(bst._gbdt.train_score.score.sum())   # drain warmup in-flight work
     from lightgbm_tpu import profiling
-    rows_t0 = profiling.counter_value("tree/hist_rows_touched")
+    rows_t0 = profiling.counter_value(profiling.HIST_ROWS_TOUCHED)
+    hx_t0 = profiling.counter_value(profiling.HIST_EXCHANGE_BYTES)
+    sr_t0 = profiling.counter_value(profiling.SPLIT_RECORDS_BYTES)
     t0 = time.perf_counter()
     for _ in range(ITERS):
         bst.update()
@@ -248,8 +256,15 @@ def main():
     s_per_iter = dt / ITERS
     # histogram-kernel row traffic over the same window (the live-rows
     # metric of the gathered-vs-masked A/B; 0 for non-rounds learners)
-    rows_per_iter = (profiling.counter_value("tree/hist_rows_touched")
+    rows_per_iter = (profiling.counter_value(profiling.HIST_ROWS_TOUCHED)
                      - rows_t0) / ITERS
+    # data-parallel comms traffic per iteration (per-device payload of
+    # the histogram exchange + the psum_scatter record allgather; 0 on
+    # single-device runs) — the hist_exchange=psum|psum_scatter A/B
+    hx_bytes_per_iter = (profiling.counter_value(
+        profiling.HIST_EXCHANGE_BYTES) - hx_t0) / ITERS
+    sr_bytes_per_iter = (profiling.counter_value(
+        profiling.SPLIT_RECORDS_BYTES) - sr_t0) / ITERS
 
     root = os.path.dirname(os.path.abspath(__file__))
     vs = 0.0
@@ -300,6 +315,11 @@ def main():
         # and its measured histogram row traffic
         "hist_rows": getattr(bst._gbdt.learner, "hist_rows", "n/a"),
         "rows_touched_per_iter": round(rows_per_iter, 1),
+        # the histogram exchange that ran (auto resolves per payload/
+        # topology) and its measured per-device comms traffic
+        "hist_exchange": getattr(bst._gbdt.learner, "hist_exchange", "n/a"),
+        "hist_exchange_bytes_per_iter": round(hx_bytes_per_iter, 1),
+        "split_records_bytes_per_iter": round(sr_bytes_per_iter, 1),
         "kernel_flags": {
             "narrow_onehot": bool(_h.NARROW_ONEHOT),
             "fused_partition": bool(_p.FUSED_PARTITION),

@@ -115,6 +115,8 @@ PARAM_ALIASES: Dict[str, str] = {
     # row partition / ordered histograms (docs/Readme.md)
     "ordered_histograms": "hist_rows",
     "row_partition": "hist_rows",
+    # data-parallel histogram exchange (docs/Readme.md "Histogram exchange")
+    "histogram_reduce": "hist_exchange",
 }
 
 # objective name aliases (reference config.cpp GetObjectiveType handling)
@@ -278,10 +280,21 @@ class Config:
     # device-resident row partition (the reference's DataPartition +
     # ordered-gradients design, data_partition.hpp) and histograms only
     # the leaf-contiguous segments each round needs — bagged/GOSS-dropped
-    # rows never enter the permutation.  "auto" = gathered on
-    # single-device TPU, masked elsewhere (shard-map stays masked until
-    # per-shard local compaction lands).
+    # rows never enter the permutation.  "auto" = gathered on TPU
+    # (single-device AND data-parallel shard-map — the partition is
+    # per-shard local state), masked on the CPU tier.
     hist_rows: str = "auto"
+    # data-parallel histogram exchange: "psum" all-reduces the full
+    # [K, F, 3, B] histogram onto every device; "psum_scatter"
+    # reduce-scatters over the feature axis so each device owns only its
+    # F/ndev slice, split-searches that slice, and all_gathers the tiny
+    # per-leaf best-split records (the reference's Network::ReduceScatter
+    # design, data_parallel_tree_learner.cpp:118-160) — comms volume and
+    # split-search work per device both drop ~ndev x.  "auto" =
+    # psum_scatter when the per-pass payload is large enough to pay for
+    # the extra record exchange, psum for small payloads (the reference's
+    # allgather-vs-halving switch).
+    hist_exchange: str = "auto"
 
     # -- network (config.h:245-252)
     num_machines: int = 1
@@ -413,6 +426,8 @@ def check_param_conflict(cfg: Config) -> None:
         raise ValueError(f"unknown tree_growth: {cfg.tree_growth}")
     if cfg.hist_rows not in ("auto", "gathered", "masked"):
         raise ValueError(f"unknown hist_rows: {cfg.hist_rows}")
+    if cfg.hist_exchange not in ("auto", "psum", "psum_scatter"):
+        raise ValueError(f"unknown hist_exchange: {cfg.hist_exchange}")
     if not (0 <= cfg.serve_port <= 65535):
         raise ValueError("serve_port must be in [0, 65535]")
     if cfg.max_batch_rows < 1:

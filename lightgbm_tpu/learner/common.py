@@ -184,7 +184,7 @@ def gathered_scratch_fits(num_columns: int, np_rows: int,
     return scratch <= 0.15 * limit_bytes
 
 
-def resolve_hist_rows(cfg: Config, *, backend: str, data_parallel: bool,
+def resolve_hist_rows(cfg: Config, *, backend: str,
                       num_columns: int, np_rows: int,
                       bins_itemsize: int = 4) -> str:
     """Resolve the `hist_rows` knob to the mode a rounds learner runs.
@@ -192,17 +192,14 @@ def resolve_hist_rows(cfg: Config, *, backend: str, data_parallel: bool,
     "masked" streams the full [F, N] bin store every histogram pass;
     "gathered" maintains the device-resident row partition and feeds
     the kernels only the leaf-contiguous segments they need.  "auto"
-    picks gathered on single-device TPU (the bandwidth-bound regime the
-    optimization targets) and masked elsewhere: masked remains the
-    shard-map path until per-shard local compaction lands, and the CPU
-    tier keeps its committed masked behavior unless opted in."""
+    picks gathered on TPU (the bandwidth-bound regime the optimization
+    targets) — including multi-device data-parallel meshes, where the
+    permutation, (offset, count) table, and gather scratch are per-shard
+    locals inside the shard_map body (`np_rows` is then the PER-SHARD
+    row count and sizes the scratch budget) — and masked on the CPU
+    tier unless opted in."""
     mode = getattr(cfg, "hist_rows", "auto")
     from .. import log
-    if data_parallel:
-        if mode == "gathered":
-            log.warning("hist_rows=gathered is not shard-map aware yet; "
-                        "using masked for data-parallel training")
-        return "masked"
     if mode == "auto":
         mode = "gathered" if backend == "pallas" else "masked"
     if mode == "gathered" and not gathered_scratch_fits(
@@ -210,6 +207,46 @@ def resolve_hist_rows(cfg: Config, *, backend: str, data_parallel: bool,
         log.warning("hist_rows=gathered scratch would not fit the device "
                     "memory budget at this shape; using masked")
         return "masked"
+    return mode
+
+
+# `hist_exchange=auto` switches to psum_scatter only when the per-pass
+# histogram payload is at least this many bytes: below it the full psum
+# is cheaper than reduce-scatter + the per-leaf record allgather
+# (mirroring the reference's allgather-vs-Recursive-Halving switch on
+# small payloads, network.cpp ReduceScatter dispatch / SURVEY.md §2.8).
+# The measured crossover on chip is captured by
+# scripts/profile_hotpath.py (hist_exchange_ab_measured.json); override
+# for on-chip tuning with LGBT_HIST_EXCHANGE_MIN_BYTES.
+HIST_EXCHANGE_MIN_SCATTER_BYTES = 1 << 20
+
+
+def _hist_exchange_threshold() -> int:
+    import os
+    raw = os.environ.get("LGBT_HIST_EXCHANGE_MIN_BYTES", "")
+    if not raw:
+        return HIST_EXCHANGE_MIN_SCATTER_BYTES
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        from .. import log
+        log.warning(f"ignoring malformed LGBT_HIST_EXCHANGE_MIN_BYTES="
+                    f"{raw!r}")
+        return HIST_EXCHANGE_MIN_SCATTER_BYTES
+
+
+def resolve_hist_exchange(cfg: Config, *, ndev: int,
+                          payload_bytes: float) -> str:
+    """Resolve `hist_exchange` to the collective a data-parallel learner
+    runs per histogram pass.  `payload_bytes` is the full reduced
+    histogram size of one pass (K * F * 3 * B * 4); with a single device
+    there is no exchange and the answer is always "psum" (a no-op)."""
+    if ndev <= 1:
+        return "psum"
+    mode = getattr(cfg, "hist_exchange", "auto")
+    if mode == "auto":
+        return ("psum_scatter"
+                if payload_bytes >= _hist_exchange_threshold() else "psum")
     return mode
 
 

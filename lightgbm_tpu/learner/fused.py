@@ -36,11 +36,12 @@ import numpy as np
 
 from ..config import Config
 from ..dataset import Dataset
-from .common import (make_split_kw, padded_bin_count, sentinel_bins_t,
-                     use_parent_hist_cache)
+from .common import (make_split_kw, padded_bin_count, resolve_hist_exchange,
+                     sentinel_bins_t, use_parent_hist_cache)
 from ..ops.histogram import histogram_full_masked
 from ..ops.split import (best_split, bundle_predicate_params,
-                         identity_feat_table, leaf_output, maybe_unbundle,
+                         combine_sharded_records, identity_feat_table,
+                         leaf_output, maybe_unbundle, sharded_slice_search,
                          store_go_left)
 from ..tree import Tree, NUMERICAL_DECISION, CATEGORICAL_DECISION
 from ..binning import CATEGORICAL
@@ -80,6 +81,7 @@ def build_tree(bins, grad, hess, row_mask, num_bins, is_cat, fmask, ftbl,
                input_dtype: str = "float32",
                voting_k: int = 0,
                num_machines: int = 1,
+               hist_exchange: str = "psum",
                cache_parent_hist: bool = True):
     """Grow one tree; runs per-shard inside `shard_map` (or standalone when
     both axes are None).
@@ -104,14 +106,39 @@ def build_tree(bins, grad, hess, row_mask, num_bins, is_cat, fmask, ftbl,
              if feature_axis is not None else jnp.int32(0))
 
     voting = voting_k > 0 and data_axis is not None
+    # psum_scatter exchange (hist_exchange knob; the reference's
+    # Network::ReduceScatter ownership, data_parallel_tree_learner.cpp:
+    # 118-160): each device reduces and keeps only its Floc/nd slice of
+    # the histogram's column axis, split-searches the slice, and the
+    # per-leaf records are all_gathered + argmaxed in find_best.  The
+    # voting learner routes its selected-subset exchange through the
+    # same switch inside find_best_voting.
+    hx = (hist_exchange == "psum_scatter" and data_axis is not None
+          and not voting)
+    hx_vote = hist_exchange == "psum_scatter" and voting
+    nd = num_machines if data_axis is not None else 1
+    if hx:
+        assert Floc % nd == 0, (
+            f"psum_scatter needs store columns ({Floc}) divisible by the "
+            f"data-axis size ({nd}); the learner pads the store")
+    Fs = Floc // nd if hx else Floc
+
+    def make_local_hist(mask):
+        return histogram_full_masked(bins, grad, hess, mask,
+                                     num_bins_padded=B,
+                                     input_dtype=input_dtype)
 
     def make_hist(mask):
-        h = histogram_full_masked(bins, grad, hess, mask,
-                                  num_bins_padded=B, input_dtype=input_dtype)
+        h = make_local_hist(mask)
         # voting keeps histograms LOCAL: only the voted feature subset is
         # reduced, inside find_best (PV-Tree,
         # voting_parallel_tree_learner.cpp:314-350)
-        return h if voting else _psum(h, data_axis)
+        if voting:
+            return h
+        if hx:
+            return jax.lax.psum_scatter(h, data_axis, scatter_dimension=0,
+                                        tiled=True)
+        return _psum(h, data_axis)
 
     def can_gate(p, sums):
         # can-this-child-be-split-again gate (serial_tree_learner.cpp
@@ -122,15 +149,33 @@ def build_tree(bins, grad, hess, row_mask, num_bins, is_cat, fmask, ftbl,
         return p.at[0].set(gain)
 
     def find_best(hist, sums):
-        """Global best split record given this shard's histogram block and
-        the leaf's GLOBAL (sum_grad, sum_hess, count)."""
+        """Global best split record given this shard's histogram block
+        (the reduce-scattered column slice under psum_scatter) and the
+        leaf's GLOBAL (sum_grad, sum_hess, count)."""
         if voting:
             return find_best_voting(hist, sums)
-        rec = best_split(maybe_unbundle(hist, unb, sums),
-                         num_bins, is_cat, fmask,
-                         sums[0], sums[1], sums[2], **skw)
-        p = rec.packed()
-        p = p.at[1].add(f_off.astype(jnp.float32))
+        if hx:
+            off = jax.lax.axis_index(data_axis) * Fs
+            if unb is None:
+                nb_s = jax.lax.dynamic_slice_in_dim(num_bins, off, Fs)
+                ic_s = jax.lax.dynamic_slice_in_dim(is_cat, off, Fs)
+                fm_s = jax.lax.dynamic_slice_in_dim(fmask, off, Fs)
+                # fold the FEATURE-shard base into the slice offset so
+                # the shared search emits global feature ids directly
+                off = off + f_off
+            else:
+                nb_s = ic_s = fm_s = None
+            p = sharded_slice_search(
+                hist, sums, off=off, nb_s=nb_s, ic_s=ic_s, fm_s=fm_s,
+                num_bins=num_bins, is_cat=is_cat, fmask=fmask,
+                unb=unb, skw=skw)
+            p = combine_sharded_records(p, data_axis)
+        else:
+            rec = best_split(maybe_unbundle(hist, unb, sums),
+                             num_bins, is_cat, fmask,
+                             sums[0], sums[1], sums[2], **skw)
+            p = rec.packed()
+            p = p.at[1].add(f_off.astype(jnp.float32))
         if feature_axis is not None:
             allp = jax.lax.all_gather(p, feature_axis)     # [k, 11]
             # argmax picks the first max → smallest shard → smallest
@@ -162,6 +207,36 @@ def build_tree(bins, grad, hess, row_mask, num_bins, is_cat, fmask, ftbl,
         votes = jnp.zeros(per_feat.shape[0], jnp.int32).at[allv].add(1)
         k2 = min(2 * k, per_feat.shape[0])
         _, sel = jax.lax.top_k(votes, k2)                  # [2k] selected
+        if hx_vote:
+            # same comms layer as the data-parallel learner: reduce-
+            # scatter the voted subset over its slot axis (padded to a
+            # data-axis multiple by repeating slot 0 — duplicates yield
+            # identical records, which the argmax collapses), search this
+            # shard's slots only, then allgather + argmax the records
+            k2p = nd * ((k2 + nd - 1) // nd)
+            selp = jnp.concatenate(
+                [sel, jnp.broadcast_to(sel[:1], (k2p - k2,))]) \
+                if k2p > k2 else sel
+            hs = jax.lax.psum_scatter(hist_local[selp], data_axis,
+                                      scatter_dimension=0, tiled=True)
+            ks = k2p // nd
+            sel_s = jax.lax.dynamic_slice_in_dim(
+                selp, jax.lax.axis_index(data_axis) * ks, ks)
+            rec = best_split(hs, num_bins[sel_s], is_cat[sel_s],
+                             fmask[sel_s], sums[0], sums[1], sums[2],
+                             **skw)
+            p = rec.packed()
+            # combine on the GLOBAL slot id so gain ties break by vote
+            # rank exactly like the psum path's flat argmax over the
+            # [2k, B] selected block (a padded duplicate slot has a
+            # larger id and so loses ties to its original); the slot
+            # maps back to its feature after the combine
+            gslot = jax.lax.axis_index(data_axis) * ks + rec.feature
+            p = p.at[1].set(gslot.astype(jnp.float32))
+            p = combine_sharded_records(p, data_axis)
+            p = p.at[1].set(selp[p[1].astype(jnp.int32)]
+                            .astype(jnp.float32))
+            return can_gate(p, sums)
         hist_sel = _psum(hist_local[sel], data_axis)       # [2k, 3, B]
         rec = best_split(hist_sel, num_bins[sel], is_cat[sel], fmask[sel],
                          sums[0], sums[1], sums[2], **skw)
@@ -185,15 +260,29 @@ def build_tree(bins, grad, hess, row_mask, num_bins, is_cat, fmask, ftbl,
         return gl
 
     # ---- root ---------------------------------------------------------------
-    hist0 = make_hist(row_mask)
-    # every row lands in exactly one bin of each feature, so any single
-    # feature's bin sums give the leaf totals; feature blocks are sharded,
-    # so reduce a local feature and max over shards (only shards with >=1
-    # real feature agree; all shards see identical rows)
-    sum_g = jnp.sum(hist0[0, 0, :])
-    sum_h = jnp.sum(hist0[0, 1, :])
-    cnt = jnp.sum(hist0[0, 2, :])
-    root_sums = jnp.stack([sum_g, sum_h, cnt])
+    if hx:
+        # leaf totals must be bitwise REPLICATED across data shards (they
+        # gate control flow): partial sums of the LOCAL pass reduced with
+        # one tiny psum — the scattered slice's column order differs per
+        # shard, so summing it directly would diverge in f32 ulps
+        h0_loc = make_local_hist(row_mask)
+        root_sums = jax.lax.psum(
+            jnp.stack([jnp.sum(h0_loc[0, 0, :]), jnp.sum(h0_loc[0, 1, :]),
+                       jnp.sum(h0_loc[0, 2, :])]), data_axis)
+        sum_g, sum_h, cnt = root_sums[0], root_sums[1], root_sums[2]
+        hist0 = jax.lax.psum_scatter(h0_loc, data_axis,
+                                     scatter_dimension=0, tiled=True)
+    else:
+        hist0 = make_hist(row_mask)
+        # every row lands in exactly one bin of each feature, so any
+        # single feature's bin sums give the leaf totals; feature blocks
+        # are sharded, so reduce a local feature and max over shards
+        # (only shards with >=1 real feature agree; all shards see
+        # identical rows)
+        sum_g = jnp.sum(hist0[0, 0, :])
+        sum_h = jnp.sum(hist0[0, 1, :])
+        cnt = jnp.sum(hist0[0, 2, :])
+        root_sums = jnp.stack([sum_g, sum_h, cnt])
     if voting:
         # hist0 is local in voting mode; root totals are global
         root_sums = _psum(root_sums, data_axis)
@@ -211,8 +300,10 @@ def build_tree(bins, grad, hess, row_mask, num_bins, is_cat, fmask, ftbl,
     leaf_side = jnp.zeros(L, jnp.int32)
     # leaf-hist cache for the parent-subtraction trick; dropped when the
     # pool budget binds (reference HistogramPool, feature_histogram.hpp:
-    # 313-475) — both children are then histogrammed directly
-    leaf_hist = (jnp.zeros((L, Floc, 3, B), jnp.float32).at[0].set(hist0)
+    # 313-475) — both children are then histogrammed directly.  Under
+    # psum_scatter the cache holds this shard's column SLICES (nd x less
+    # memory per device)
+    leaf_hist = (jnp.zeros((L,) + hist0.shape, jnp.float32).at[0].set(hist0)
                  if cache_parent_hist
                  else jnp.zeros((1, 1, 1, 1), jnp.float32))
 
@@ -440,12 +531,28 @@ class FusedTreeLearner:
         cfg = config
         voting = (getattr(cfg, "tree_learner", "") == "voting"
                   and self.dd > 1)
+        self._voting = voting
         # EFB: histogram over the narrower bundled store.  Feature
         # sharding and voting need per-ORIGINAL-feature store rows (the
         # vote / shard ownership is per feature), so they fall back to
         # the unbundled view of the same plan
         plan = dataset.bundle_plan
         self.use_bundle = plan is not None and self.df == 1 and not voting
+        # data-parallel histogram exchange: resolve the collective from
+        # the per-pass payload (the voted subset for PV-Tree), then size
+        # the store so the histogram's column axis tiles the data axis
+        # under psum_scatter
+        pay_cols = (dataset.bins.shape[0] if self.use_bundle
+                    else max(1, self.Fp // self.df))
+        if voting:
+            pay_cols = max(1, min(2 * int(cfg.top_k), self.F))
+        self.hist_exchange = resolve_hist_exchange(
+            cfg, ndev=self.dd, payload_bytes=4.0 * pay_cols * 3 * self.B)
+        hx_pad = (self.hist_exchange == "psum_scatter" and self.dd > 1
+                  and not voting)
+        if hx_pad and not self.use_bundle:
+            fd = self.df * self.dd
+            self.Fp = int(fd * math.ceil(self.F / fd))
         if self.use_bundle:
             store = dataset.bins
             bins_np = store.astype(np.int32)
@@ -453,6 +560,13 @@ class FusedTreeLearner:
                 bins_np = np.pad(bins_np,
                                  ((0, 0), (0, self._local_np - self.N)))
             self.Cstore = store.shape[0]
+            if hx_pad and self.Cstore % self.dd:
+                # trivial zero columns so the bundled store tiles the
+                # data axis (the unbundle sentinel must sit past them)
+                cp = self.dd * int(math.ceil(self.Cstore / self.dd)) \
+                    - self.Cstore
+                bins_np = np.pad(bins_np, ((0, cp), (0, 0)))
+                self.Cstore += cp
         else:
             base = (dataset.bins if plan is None
                     else dataset.unbundled_bins())
@@ -472,7 +586,7 @@ class FusedTreeLearner:
         # (shard_map-safe; a few hundred KB at worst)
         if self.use_bundle:
             ftbl = plan.feat_table()
-            unb = dataset.unbundle_tables(self.B)
+            unb = dataset.unbundle_tables(self.B, self.Cstore)
         else:
             ftbl = np.asarray(identity_feat_table(nb))
             unb = None
@@ -481,15 +595,20 @@ class FusedTreeLearner:
         self._feat_rng = np.random.RandomState(cfg.feature_fraction_seed)
 
         # histogram-memory bound (reference HistogramPool analog); the
-        # column count is this shard's local share of the STORE
+        # column count is this shard's local share of the STORE — under
+        # psum_scatter each device caches only its column slice
+        cache_cols = self.Cstore // self.df
+        if hx_pad:
+            cache_cols = max(1, cache_cols // self.dd)
         self.cache_parent_hist = use_parent_hist_cache(
-            cfg, self.Cstore // self.df, self.B)
+            cfg, cache_cols, self.B)
         kw = dict(num_leaves=cfg.num_leaves, num_bins_padded=self.B,
                   split_kw=self.split_kw, max_depth=int(cfg.max_depth),
                   min_data_in_leaf=int(cfg.min_data_in_leaf),
                   min_sum_hessian_in_leaf=float(cfg.min_sum_hessian_in_leaf),
                   voting_k=int(cfg.top_k) if voting else 0,
                   num_machines=self.dd,
+                  hist_exchange=self.hist_exchange,
                   cache_parent_hist=self.cache_parent_hist,
                   input_dtype=getattr(cfg, "histogram_dtype", "float32"))
         if mesh is None:
@@ -551,6 +670,32 @@ class FusedTreeLearner:
             return x
         return jnp.pad(x, (0, self.Np - self.N))
 
+    def _record_comm_stats(self) -> None:
+        """Per-tree comms accounting for the data-parallel exchange.
+        The fused builder's fori_loop always runs num_leaves-1 bodies
+        (no-op splits still execute their collectives), so the per-tree
+        byte totals are STATIC — recorded host-side, no device scalar
+        needed (unlike the rounds learner's cond-skipped chunks)."""
+        if self.dd <= 1:
+            return
+        from .. import profiling
+        L = self.config.num_leaves
+        hxs = self.hist_exchange == "psum_scatter"
+        calls = 1 + 2 * (L - 1)               # find_best invocations
+        if self._voting:
+            k2 = max(1, min(2 * int(self.config.top_k), self.F))
+            k2p = self.dd * ((k2 + self.dd - 1) // self.dd) if hxs else k2
+            per = 4.0 * (k2p // self.dd if hxs else k2) * 3 * self.B
+            hx_bytes = per * calls
+        else:
+            cols = self.Cstore // self.df
+            per = 4.0 * (cols // self.dd if hxs else cols) * 3 * self.B
+            passes = 1 + (L - 1) * (1 if self.cache_parent_hist else 2)
+            hx_bytes = per * passes
+        profiling.count(profiling.HIST_EXCHANGE_BYTES, hx_bytes)
+        profiling.count(profiling.SPLIT_RECORDS_BYTES,
+                        4.0 * self.dd * 11 * calls if hxs else 0.0)
+
     def train(self, grad: jax.Array, hess: jax.Array,
               bag_idx: Optional[jax.Array] = None,
               bag_count: Optional[int] = None) -> Tuple[Tree, jax.Array]:
@@ -574,6 +719,7 @@ class FusedTreeLearner:
         arrs, leaf_id = self._build(
             self.bins_dev, self._pad_rows(grad), self._pad_rows(hess), mask,
             self.num_bins_dev, self.is_cat_dev, self._feature_mask())
+        self._record_comm_stats()
         tree = tree_arrays_to_host(arrs, self.dataset,
                                    self.config.num_leaves)
         if self.mh is not None:

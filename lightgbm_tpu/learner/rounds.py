@@ -24,8 +24,14 @@ splits of distinct leaves are independent, and every positive-gain leaf is
 split in both policies.  They differ only in WHICH splits are kept once
 `num_leaves` runs out (greedy-per-split vs greedy-per-round).
 
-Data-parallel: rows sharded on the mesh "data" axis, histograms psum'd —
-same mapping as learner/fused.py.
+Data-parallel: rows sharded on the mesh "data" axis; histograms are
+exchanged per pass either by full `lax.psum` or — the default at real
+shapes — by `lax.psum_scatter` over the store-column axis, where each
+device reduces and keeps only its F/ndev feature slice, split-searches
+it, and all_gathers the per-leaf best-split records (the reference's
+Network::ReduceScatter ownership model, data_parallel_tree_learner.cpp:
+118-160; `hist_exchange` knob).  The gathered row partition is per-shard
+local state, so `hist_rows=gathered` composes with both exchanges.
 """
 from __future__ import annotations
 
@@ -40,13 +46,15 @@ import numpy as np
 from ..config import Config
 from ..dataset import Dataset
 from .common import (gather_capacity_tiers, gather_scratch_capacity,
-                     make_split_kw, padded_bin_count, resolve_hist_rows,
-                     sentinel_bins_t, use_parent_hist_cache)
+                     make_split_kw, padded_bin_count, resolve_hist_exchange,
+                     resolve_hist_rows, sentinel_bins_t,
+                     use_parent_hist_cache)
 from .fused import TreeArrays, tree_arrays_to_host
 from ..ops.histogram import hist_multileaf_gathered, hist_multileaf_masked
 from ..ops.partition import partition_rows
 from ..ops.split import (best_split, bundle_predicate_params,
-                         identity_feat_table, leaf_output, maybe_unbundle)
+                         combine_sharded_records, identity_feat_table,
+                         leaf_output, maybe_unbundle, sharded_slice_search)
 from ..tree import Tree
 
 NEG_INF = -jnp.inf
@@ -119,23 +127,46 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
                       max_rounds: int = 0,
                       cache_parent_hist: bool = True,
                       hist_rows: str = "masked",
+                      hist_exchange: str = "psum",
+                      num_devices: int = 1,
                       leaves_per_batch: int = 0):
     """Grow one tree in batched rounds.  Shapes as learner/fused.build_tree.
-    Returns (TreeArrays, leaf_id, rows_touched) — rows_touched is the
-    f32 count of rows processed by histogram kernels for this tree (the
-    live-traffic metric behind the gathered-vs-masked A/B).
+    Returns (TreeArrays, leaf_id, stats) — stats is a [3] f32 vector:
+    (rows processed by histogram kernels — global across shards — the
+    live-traffic metric behind the gathered-vs-masked A/B; per-device
+    histogram-exchange payload bytes; per-device best-split-record
+    allgather bytes).
 
-    hist_rows="gathered" (static; single-device only — callers resolve
-    via common.resolve_hist_rows) maintains a device-resident row
-    partition inside the while_loop: a [N] row permutation grouped by
-    leaf plus per-leaf (offset, count), stably compacted after each
-    round's partition_rows exactly like the reference's
-    DataPartition::Split (data_partition.hpp:80-130).  Histogram passes
-    then gather only the leaf-contiguous segments they need into a
-    static scratch (sum of smaller children <= N/2 by construction)
-    instead of streaming all N rows; bagged/GOSS-dropped rows never
-    enter the permutation.  "masked" is the original full-stream path
-    and remains what shard-map runs.
+    hist_rows="gathered" maintains a device-resident row partition
+    inside the while_loop: a [N] row permutation grouped by leaf plus
+    per-leaf (offset, count), stably compacted after each round's
+    partition_rows exactly like the reference's DataPartition::Split
+    (data_partition.hpp:80-130).  Histogram passes then gather only the
+    leaf-contiguous segments they need into a static scratch (sum of
+    smaller children <= N/2 by construction) instead of streaming all N
+    rows; bagged/GOSS-dropped rows never enter the permutation.  Under
+    shard_map everything — permutation, (offset, count) table, scratch,
+    capacity tiers (static at ceil(N_local/2)) — is per-shard local
+    state over the shard's row block; per-shard counts diverge, but the
+    tier lax.cond branches contain no collectives, so shards may pick
+    different tiers freely.  "masked" is the original full-stream path.
+
+    hist_exchange="psum_scatter" (static; with data_axis set and
+    num_devices the data-axis size) replaces the full [K, F, 3, B]
+    histogram psum with a reduce-scatter over the store-column axis:
+    each device reduces and keeps only its F/num_devices column slice
+    (the reference's ReduceScatter ownership model,
+    data_parallel_tree_learner.cpp:118-160), runs best-split search on
+    that slice only (bundle-aware: the slice is unbundled per shard via
+    ops/split.unbundle_hist_local), then all_gathers the per-leaf
+    packed records and combines them (max gain, ties to the smallest
+    feature id — ops/split.combine_sharded_records).  Per-device comms
+    drop ~num_devices x always; split-search work drops too on the
+    identity store (the bundled path re-scans the full original-feature
+    layout per shard — EFB already shrank the histogrammed width).  The
+    parent-histogram cache holds column SLICES in this mode
+    (num_devices x less memory).  F must then divide evenly by
+    num_devices (callers pad the store).
 
     `bins` holds STORE columns (bundled under EFB); num_bins/is_cat/fmask
     are per-ORIGINAL-feature.  `ftbl` is the [5, F] feature→column table
@@ -156,12 +187,53 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
     B = num_bins_padded
     K = leaves_per_batch or LEAVES_PER_BATCH
     n_chunks = (L + K - 1) // K
-    gathered = hist_rows == "gathered" and data_axis is None
+    gathered = hist_rows == "gathered"
+    hx = hist_exchange == "psum_scatter" and data_axis is not None
+    nd = num_devices if data_axis is not None else 1
+    if hx:
+        assert F % nd == 0, (
+            f"psum_scatter needs store columns ({F}) divisible by the "
+            f"data-axis size ({nd}); the learner pads the store")
+    Fs = F // nd if hx else F
+
+    def exchange(h):
+        """Reduce a LOCAL histogram [..., F, 3, B] across the data axis:
+        full psum, or reduce-scatter keeping this shard's [Fs, 3, B]
+        store-column slice."""
+        if data_axis is None:
+            return h
+        if hx:
+            return jax.lax.psum_scatter(h, data_axis,
+                                        scatter_dimension=h.ndim - 3,
+                                        tiled=True)
+        return jax.lax.psum(h, data_axis)
+
+    def _exchange_bytes(k2: int) -> float:
+        """Per-device reduced-histogram payload of one k2-leaf pass:
+        the full tensor under psum, the F/nd slice under psum_scatter."""
+        if data_axis is None:
+            return 0.0
+        return 4.0 * k2 * (Fs if hx else F) * 3 * B
+
+    def _records_bytes(k2: int) -> float:
+        """Per-device payload of the best-split-record allgather (only
+        the psum_scatter path exchanges records)."""
+        return 4.0 * nd * k2 * 11 if hx else 0.0
+
     if gathered:
         # static capacity tiers: smaller-child passes are bounded by
         # ceil(N/2); direct large-child passes (bounded-memory mode) by N
-        tiers_small = gather_capacity_tiers(gather_scratch_capacity(Nloc))
         tiers_all = gather_capacity_tiers(Nloc)
+        tiers_small = gather_capacity_tiers(gather_scratch_capacity(Nloc))
+        if data_axis is not None:
+            # the ceil(N/2) smaller-child bound is GLOBAL: smaller/larger
+            # is decided on global counts, so one shard's local share of
+            # the globally-smaller children can reach ALL of its rows.
+            # Keep the N/2 tier (it catches the typical balanced pass,
+            # preserving the rows-touched win) but make the full-Nloc
+            # tier reachable so a skewed shard never overflows the
+            # scratch and silently drops rows.
+            tiers_small = tuple(sorted(set(tiers_small + tiers_all)))
     if ftbl is None:
         ftbl = identity_feat_table(num_bins)
     # Termination is governed by the while_loop predicate (no positive gain
@@ -180,20 +252,47 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
         binsf = bins.astype(jnp.int32)
 
     def find_best_batch(hists, sums):
-        """hists [K2, C, 3, B] STORE histograms, sums [K2, 3] → packed
-        recs [K2, 11] in ORIGINAL feature space (unbundled per leaf),
-        with the can-split gate applied (depth gate at selection time)."""
+        """hists [K2, C, 3, B] reduced STORE histograms (C = F, or this
+        shard's Fs slice under psum_scatter), sums [K2, 3] → packed recs
+        [K2, 11] in ORIGINAL feature space (unbundled per leaf), with
+        the can-split gate applied (depth gate at selection time).
+
+        psum_scatter: each shard split-searches only its column slice
+        (ops/split.sharded_slice_search — unbundled per shard, or the
+        identity store's metadata dynamic-slice), then the [nd, K2, 11]
+        record allgather picks each leaf's max gain with ties broken by
+        smallest feature id (ops/split.combine_sharded_records — the
+        full search's flat-argmax tie-break, shard-order independent)."""
+        if hx:
+            off = jax.lax.axis_index(data_axis) * Fs
+            if unb is None:
+                nb_s = jax.lax.dynamic_slice_in_dim(num_bins, off, Fs)
+                ic_s = jax.lax.dynamic_slice_in_dim(is_cat, off, Fs)
+                fm_s = jax.lax.dynamic_slice_in_dim(fmask, off, Fs)
+            else:
+                nb_s = ic_s = fm_s = None
+
         def one(h, s):
-            rec = best_split(maybe_unbundle(h, unb, s),
-                             num_bins, is_cat, fmask,
-                             s[0], s[1], s[2], **skw)
-            p = rec.packed()
+            if hx:
+                p = sharded_slice_search(
+                    h, s, off=off, nb_s=nb_s, ic_s=ic_s, fm_s=fm_s,
+                    num_bins=num_bins, is_cat=is_cat, fmask=fmask,
+                    unb=unb, skw=skw)
+            else:
+                rec = best_split(maybe_unbundle(h, unb, s),
+                                 num_bins, is_cat, fmask,
+                                 s[0], s[1], s[2], **skw)
+                p = rec.packed()
             can = ((s[2] >= 2 * min_data_in_leaf)
                    & (s[1] >= 2 * min_sum_hessian_in_leaf))
             gain = jnp.where(can & jnp.isfinite(p[0]) & (p[0] > 0),
                              p[0], NEG_INF)
             return p.at[0].set(gain)
-        return jax.vmap(one)(hists, sums)
+
+        recs = jax.vmap(one)(hists, sums)
+        if hx:
+            recs = combine_sharded_records(recs, data_axis)
+        return recs
 
     # ---- root ---------------------------------------------------------------
     gh8 = jnp.zeros((8, Nloc), jnp.float32)
@@ -204,11 +303,22 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
                                jnp.zeros(1, jnp.int32), num_bins_padded=B,
                                backend=backend, input_dtype=input_dtype,
                                max_num_bin=max_num_bin, num_leaves=L)
-    hist0 = _psum(h0[0], data_axis)                     # [F, 3, B]
-    sum_g = jnp.sum(hist0[0, 0, :])
-    sum_h = jnp.sum(hist0[0, 1, :])
-    cnt = jnp.sum(hist0[0, 2, :])
-    root_sums = jnp.stack([sum_g, sum_h, cnt])
+    if hx:
+        # leaf totals from the LOCAL pass (any single store column's bin
+        # sums give them; store column 0 is always real) + one tiny
+        # psum — the scattered histogram no longer holds column 0 on
+        # every shard
+        ls = jnp.stack([jnp.sum(h0[0, 0, 0, :]), jnp.sum(h0[0, 0, 1, :]),
+                        jnp.sum(h0[0, 0, 2, :])])
+        root_sums = jax.lax.psum(ls, data_axis)
+        cnt = root_sums[2]
+        hist0 = exchange(h0[0])                         # [Fs, 3, B]
+    else:
+        hist0 = _psum(h0[0], data_axis)                 # [F, 3, B]
+        sum_g = jnp.sum(hist0[0, 0, :])
+        sum_h = jnp.sum(hist0[0, 1, :])
+        cnt = jnp.sum(hist0[0, 2, :])
+        root_sums = jnp.stack([sum_g, sum_h, cnt])
 
     leaf_id = jnp.zeros(Nloc, jnp.int32)
     if gathered:
@@ -228,13 +338,17 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
         perm = jnp.zeros(0, jnp.int32)
         leaf_off = jnp.zeros(0, jnp.int32)
         leaf_cnt = jnp.zeros(0, jnp.int32)
-    rows_touched = jnp.float32(Nloc)               # the masked root pass
+    # (rows touched by hist kernels, exchange bytes, record bytes) — the
+    # root contributes one masked full-stream pass + one exchange
+    stats = jnp.asarray([float(Nloc), _exchange_bytes(1),
+                         _records_bytes(1)], jnp.float32)
     leaf_best = jnp.full((L, 11), NEG_INF, jnp.float32).at[0].set(
         find_best_batch(hist0[None], root_sums[None])[0])
     leaf_depth = jnp.zeros(L, jnp.int32)
     leaf_parent = jnp.full(L, -1, jnp.int32)
     leaf_side = jnp.zeros(L, jnp.int32)
-    leaf_hist = (jnp.zeros((L, F, 3, B), jnp.float32).at[0].set(hist0)
+    # under psum_scatter the cache holds this shard's column SLICES
+    leaf_hist = (jnp.zeros((L,) + hist0.shape, jnp.float32).at[0].set(hist0)
                  if cache_parent_hist
                  else jnp.zeros((1, 1, 1, 1), jnp.float32))
 
@@ -258,7 +372,7 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
 
     def round_body(st):
         (rnd, leaf_id, leaf_best, leaf_depth, leaf_parent, leaf_side,
-         leaf_hist, perm, leaf_off, leaf_cnt, rows_touched, arrs) = st
+         leaf_hist, perm, leaf_off, leaf_cnt, stats, arrs) = st
         n_leaves = arrs.num_leaves
 
         # ---- select this round's splits (top-gain within the cap) ---------
@@ -467,7 +581,7 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
 
         leaf_best2 = leaf_best
         leaf_hist2 = leaf_hist
-        rows2 = rows_touched
+        stats2 = stats
         for c in range(n_chunks):
             s = c * K
             Kc = min(K, L - s)                               # last chunk short
@@ -475,28 +589,31 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
             sl = small_leaf[s:s + Kc]
 
             def do_chunk(args, s=s, Kc=Kc, dk=dk, sl=sl):
-                leaf_best2, leaf_hist2, rt = args
+                leaf_best2, leaf_hist2, stv = args
                 slv = jnp.where(dk, sl, -1)                  # -1 = empty slot
                 if gathered:
                     h_small, rtp = hist_gathered_tiered(slv, tiers_small)
-                    rt = rt + rtp
+                    stv = stv.at[0].add(rtp)
                 else:
                     h_small = hist_tiered(slv, dk, Kc)
-                    rt = rt + jnp.float32(Nloc)
-                h_small = _psum(h_small, data_axis)          # [Kc, F, 3, B]
+                    stv = stv.at[0].add(jnp.float32(Nloc))
+                h_small = exchange(h_small)        # [Kc, F|Fs, 3, B]
+                stv = stv.at[1].add(_exchange_bytes(Kc))
                 if cache_parent_hist:
                     h_large = leaf_hist2[pl_[s:s + Kc]] - h_small
                 else:
                     llv = jnp.where(dk, large_leaf[s:s + Kc], -1)
                     if gathered:
                         h_large, rtp = hist_gathered_tiered(llv, tiers_all)
-                        rt = rt + rtp
+                        stv = stv.at[0].add(rtp)
                     else:
                         h_large = hist_tiered(llv, dk, Kc)
-                        rt = rt + jnp.float32(Nloc)
-                    h_large = _psum(h_large, data_axis)
+                        stv = stv.at[0].add(jnp.float32(Nloc))
+                    h_large = exchange(h_large)
+                    stv = stv.at[1].add(_exchange_bytes(Kc))
                 rec_s = find_best_batch(h_small, small_sums[s:s + Kc])
                 rec_l = find_best_batch(h_large, large_sums[s:s + Kc])
+                stv = stv.at[2].add(2 * _records_bytes(Kc))
                 sil = small_is_left[s:s + Kc, None]
                 recL = jnp.where(sil, rec_s, rec_l)
                 recR = jnp.where(sil, rec_l, rec_s)
@@ -511,18 +628,18 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
                         hR, mode="drop")
                 else:
                     lh = leaf_hist2
-                return lb, lh, rt
+                return lb, lh, stv
 
             def skip_chunk(args):
                 return args
 
-            leaf_best2, leaf_hist2, rows2 = jax.lax.cond(
+            leaf_best2, leaf_hist2, stats2 = jax.lax.cond(
                 jnp.any(dk), do_chunk, skip_chunk,
-                (leaf_best2, leaf_hist2, rows2))
+                (leaf_best2, leaf_hist2, stats2))
 
         return (rnd + 1, leaf_id2, leaf_best2, leaf_depth2, leaf_parent2,
                 leaf_side2, leaf_hist2, perm2, leaf_off2, leaf_cnt2,
-                rows2, arrs2)
+                stats2, arrs2)
 
     def round_cond(st):
         rnd, leaf_best, leaf_depth, arrs = st[0], st[2], st[3], st[-1]
@@ -532,10 +649,13 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
                 & jnp.any(gated > 0))
 
     st = (jnp.int32(0), leaf_id, leaf_best, leaf_depth, leaf_parent,
-          leaf_side, leaf_hist, perm, leaf_off, leaf_cnt, rows_touched,
+          leaf_side, leaf_hist, perm, leaf_off, leaf_cnt, stats,
           arrs)
     st = jax.lax.while_loop(round_cond, round_body, st)
-    return st[-1], st[1], _psum(st[-2], data_axis)
+    # rows are summed across shards (global traffic); the byte counters
+    # stay per-device (passes are uniform, so every shard agrees)
+    stv = st[-2]
+    return st[-1], st[1], stv.at[0].set(_psum(stv[0], data_axis))
 
 
 class RoundsTreeLearner:
@@ -589,6 +709,19 @@ class RoundsTreeLearner:
         else:
             bins_np = store.astype(np.int32)
             self.Fpad = self.Cstore
+        # data-parallel histogram exchange: resolve the collective from
+        # the per-pass payload, then (for psum_scatter) align the store
+        # columns so the [K, F, 3, B] histogram tiles the data axis —
+        # each device owns an F/ndev store-column slice.  Alignment
+        # keeps the int8 kernel's 32-sublane grouping.
+        K_pass = min(LEAVES_PER_BATCH, int(config.num_leaves))
+        self.hist_exchange = resolve_hist_exchange(
+            config, ndev=self.dd,
+            payload_bytes=4.0 * K_pass * self.Fpad * 3 * self.B)
+        if self.hist_exchange == "psum_scatter" and self.dd > 1:
+            align = math.lcm(self.dd,
+                             32 if bins_np.dtype == np.int8 else 1)
+            self.Fpad = align * int(math.ceil(self.Fpad / align))
         # pad value must be an in-range bin; padded rows/features carry
         # zero mask so their bin never matters
         pad_val = -128 if bins_np.dtype == np.int8 else 0
@@ -626,14 +759,21 @@ class RoundsTreeLearner:
         self._feat_rng = np.random.RandomState(cfg.feature_fraction_seed)
 
         # histogram-memory bound (reference HistogramPool analog); the
-        # column count is this shard's local share of the STORE
-        self.cache_parent_hist = use_parent_hist_cache(cfg, self.Fpad,
+        # column count is this shard's local share of the STORE — under
+        # psum_scatter each device caches only its F/ndev column slice
+        cache_cols = (self.Fpad // self.dd
+                      if self.hist_exchange == "psum_scatter" and self.dd > 1
+                      else self.Fpad)
+        self.cache_parent_hist = use_parent_hist_cache(cfg, cache_cols,
                                                        self.B)
         # row feed: gathered (ordered histograms over the device-resident
-        # row partition) vs masked full-stream — see build_tree_rounds
+        # row partition) vs masked full-stream — see build_tree_rounds.
+        # Under shard_map the partition is per-shard local state, so the
+        # scratch budget is sized from the PER-SHARD row count
         self.hist_rows = resolve_hist_rows(
-            cfg, backend=backend, data_parallel=self.dd > 1,
-            num_columns=self.Fpad, np_rows=self._local_np,
+            cfg, backend=backend,
+            num_columns=self.Fpad,
+            np_rows=max(1, self.Np // max(self.dd, 1)),
             bins_itemsize=int(bins_np.dtype.itemsize))
         kw = dict(num_leaves=cfg.num_leaves, num_bins_padded=self.B,
                   max_num_bin=int(dataset.max_num_bin),
@@ -643,6 +783,8 @@ class RoundsTreeLearner:
                   backend=backend,
                   cache_parent_hist=self.cache_parent_hist,
                   hist_rows=self.hist_rows,
+                  hist_exchange=self.hist_exchange,
+                  num_devices=self.dd,
                   ftbl=ftbl, unb=unb,
                   input_dtype=getattr(cfg, "histogram_dtype", "float32"))
         if mesh is None:
@@ -755,23 +897,28 @@ class RoundsTreeLearner:
         from .fused import pack_tree_arrays
         from .. import profiling
         mask, fmask = self._masks(bag_idx)
-        arrs, leaf_id, rows_t = self._build(
+        arrs, leaf_id, stats = self._build(
             self.bins_dev, self._pad_rows(grad), self._pad_rows(hess), mask,
             self.num_bins_dev, self.is_cat_dev, fmask)
-        # device scalar, folded into the counter at the next metrics
+        # device scalars, folded into the counters at the next metrics
         # read — no sync on the pipelined path
-        profiling.count_deferred("tree/hist_rows_touched", rows_t)
+        self._record_stats(profiling, stats)
         return pack_tree_arrays(arrs), leaf_id[: self.N], arrs
+
+    def _record_stats(self, profiling, stats) -> None:
+        profiling.count_deferred(profiling.HIST_ROWS_TOUCHED, stats[0])
+        profiling.count_deferred(profiling.HIST_EXCHANGE_BYTES, stats[1])
+        profiling.count_deferred(profiling.SPLIT_RECORDS_BYTES, stats[2])
 
     def train(self, grad: jax.Array, hess: jax.Array,
               bag_idx: Optional[jax.Array] = None,
               bag_count: Optional[int] = None) -> Tuple[Tree, jax.Array]:
         from .. import profiling
         mask, fmask = self._masks(bag_idx)
-        arrs, leaf_id, rows_t = self._build(
+        arrs, leaf_id, stats = self._build(
             self.bins_dev, self._pad_rows(grad), self._pad_rows(hess), mask,
             self.num_bins_dev, self.is_cat_dev, fmask)
-        profiling.count_deferred("tree/hist_rows_touched", rows_t)
+        self._record_stats(profiling, stats)
         tree = tree_arrays_to_host(arrs, self.dataset, self.config.num_leaves)
         if self.mh is not None:
             return tree, jnp.asarray(self.mh.local_rows(leaf_id))

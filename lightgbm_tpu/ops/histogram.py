@@ -879,7 +879,10 @@ def hist_multileaf_gathered(bins_fn: jax.Array, gh8: jax.Array,
 
     bins_fn : [F, N] int bins (int8 = value-128 storage, kept narrow
         through the gather); gh8 : [8, N] f32 (grad·rm, hess·rm, rm,
-        pads); perm/seg_off/seg_cnt as gather_segments.
+        pads); perm/seg_off/seg_cnt as gather_segments.  Everything here
+        is shard-local: under shard_map the caller passes its own row
+        block's permutation and segment tables, and the returned local
+        histograms are exchanged (psum / psum_scatter) afterwards.
 
     Returns [K, F, 3, B] f32 — slot k holds segment k's histogram
     (exactly hist_multileaf_masked's output for the same leaf when the
@@ -913,8 +916,9 @@ def hist_multileaf_gathered(bins_fn: jax.Array, gh8: jax.Array,
 def histogram_full_masked(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                           mask: jax.Array, *, num_bins_padded: int,
                           input_dtype: str = "float32") -> jax.Array:
-    """Full-scan masked histogram over ALL rows (no gather) — used by the
-    fused/distributed learner where row compaction is not shard-friendly.
+    """Full-scan masked histogram over ALL rows (no gather) — used by
+    the fused leaf-wise learner, whose one-leaf-at-a-time passes keep
+    mask construction cheaper than maintaining a row partition.
 
     bins: [F, N] (no sentinel), mask: [N] float32 0/1 row weights.
     Returns [F, 3, B] float32.

@@ -144,6 +144,82 @@ def maybe_unbundle(hist: jax.Array, unb, totals: jax.Array) -> jax.Array:
     return unbundle_hist(hist, unb[0], unb[1], totals)
 
 
+def unbundle_hist_local(hist: jax.Array, src: jax.Array, dmask: jax.Array,
+                        totals: jax.Array, col_start) -> tuple:
+    """Per-shard unbundle for the psum_scatter exchange: `hist` is a
+    store-column SLICE [Cs, 3, B] holding global columns
+    [col_start, col_start + Cs) of a reduce-scattered histogram;
+    src/dmask are the GLOBAL tables of BundlePlan.unbundle_tables
+    (flat indices into [C*B], sentinel C*B with C the padded column
+    count — the store must be padded so the shard slices tile C exactly
+    and the sentinel stays outside every slice's range).
+
+    Returns ([F, 3, B] histogram, owned [F] bool).  An original feature
+    lives entirely in ONE store column, so it is exact on the shard
+    owning that column and garbage elsewhere (its default-bin fill
+    reconstructs from zero sums); the split search must AND `owned`
+    into its feature mask so only the owning shard's record for each
+    feature survives the cross-shard argmax."""
+    Cs, _, B = hist.shape
+    src = jnp.asarray(src)
+    col_start = jnp.asarray(col_start, jnp.int32)
+    lo = col_start * B
+    # the global sentinel C*B sits past the last shard's range, so
+    # in_range is False for every invalid-bin entry on every shard
+    in_range = (src >= lo) & (src < lo + Cs * B)
+    owned = jnp.any(in_range, axis=1)
+    src_l = jnp.where(in_range, src - lo, Cs * B)
+    flat = hist.transpose(0, 2, 1).reshape(Cs * B, 3)
+    flat = jnp.concatenate([flat, jnp.zeros((1, 3), flat.dtype)], axis=0)
+    F, Bo = src_l.shape
+    g = flat[src_l.reshape(-1)].reshape(F, Bo, 3).transpose(0, 2, 1)
+    fill = totals[None, :, None] - jnp.sum(g, axis=2, keepdims=True)
+    return jnp.where(jnp.asarray(dmask)[:, None, :], fill, g), owned
+
+
+def sharded_slice_search(h, sums, *, off, nb_s, ic_s, fm_s,
+                         num_bins, is_cat, fmask, unb, skw) -> jax.Array:
+    """Per-shard best split of ONE leaf from its reduce-scattered
+    store-column slice (the psum_scatter exchange of learner/rounds.py
+    and learner/fused.py — shared so the two learners cannot diverge).
+
+    h : [Cs, 3, B] this shard's reduced column slice; off: the shard's
+    first global column.  Identity store (unb None): nb_s/ic_s/fm_s are
+    the shard's dynamic metadata slices and the record's feature id gets
+    `off` folded back in.  Bundled store: the slice is unbundled to the
+    full original-feature layout with non-owned features masked out of
+    the search.  Returns the packed [11] record in ORIGINAL feature
+    space; combine across shards with `combine_sharded_records`."""
+    if unb is None:
+        rec = best_split(h, nb_s, ic_s, fm_s,
+                         sums[0], sums[1], sums[2], **skw)
+        p = rec.packed()
+        return p.at[1].add(jnp.asarray(off).astype(jnp.float32))
+    hF, owned = unbundle_hist_local(h, unb[0], unb[1], sums, off)
+    rec = best_split(hF, num_bins, is_cat, fmask & owned,
+                     sums[0], sums[1], sums[2], **skw)
+    return rec.packed()
+
+
+def combine_sharded_records(recs: jax.Array, axis_name) -> jax.Array:
+    """all_gather the per-shard packed records over `axis_name` and pick
+    each leaf's winner: maximum gain, ties broken by the SMALLEST
+    feature id — every feature is owned by exactly one shard, so this
+    reproduces the full search's flat-argmax tie-break exactly even
+    when feature→shard ownership is not monotone in feature id (EFB
+    bundles order shards by store column, not original feature).
+
+    recs: [..., 11] (a single record or a [K, 11] batch); returns the
+    same shape, replicated across the axis."""
+    allr = jax.lax.all_gather(recs, axis_name)       # [nd, ..., 11]
+    gains = allr[..., 0]
+    mx = jnp.max(gains, axis=0, keepdims=True)
+    cand = jnp.where(gains == mx, allr[..., 1], jnp.inf)
+    best = jnp.argmin(cand, axis=0)
+    return jnp.take_along_axis(allr, best[None, ..., None],
+                               axis=0).squeeze(0)
+
+
 def leaf_split_gain(G, H, l1, l2):
     reg = jnp.maximum(jnp.abs(G) - l1, 0.0)
     return reg * reg / (H + l2)

@@ -35,6 +35,22 @@ _SAMPLE_CAP = 4096
 # live buffer per counter), folded into _counters on read
 _deferred: Dict[str, object] = {}
 
+# Canonical counter names of the data-parallel tree learners' comms
+# layer, fed through count_deferred (device-side accumulation, no sync
+# on the pipelined path) and read by bench.py / the MULTICHIP dryrun:
+#  - HIST_ROWS_TOUCHED: rows processed by histogram kernels (global sum
+#    across shards — the gathered-vs-masked live-traffic metric).
+#  - HIST_EXCHANGE_BYTES: PER-DEVICE histogram-collective payload —
+#    bytes of reduced histogram each device materializes per pass (the
+#    full [K, F, 3, B] tensor under psum, its F/ndev slice under
+#    psum_scatter), summed over passes.
+#  - SPLIT_RECORDS_BYTES: per-device bytes of the psum_scatter path's
+#    best-split-record allgather ([ndev, K, 11] f32 per pass; zero
+#    under psum, which exchanges no records).
+HIST_ROWS_TOUCHED = "tree/hist_rows_touched"
+HIST_EXCHANGE_BYTES = "tree/hist_exchange_bytes"
+SPLIT_RECORDS_BYTES = "tree/split_records_bytes"
+
 
 @contextmanager
 def phase(name: str, force: bool = False) -> Iterator[None]:

@@ -84,10 +84,22 @@ def main():
         "offset/count — the reference's `DataPartition` + ordered-"
         "gradients design) and histograms only the leaf-contiguous "
         "segments each round needs, so bagged/GOSS-dropped rows are "
-        "never read. `auto` = gathered on single-device TPU, masked "
-        "elsewhere (data-parallel shard-map stays masked until "
-        "per-shard local compaction lands). See docs/Readme.md "
-        "\"Row partition / ordered histograms\".",
+        "never read. `auto` = gathered on TPU — single-device AND "
+        "data-parallel shard-map, where the partition and scratch are "
+        "per-shard local state — masked on the CPU tier. See "
+        "docs/Readme.md \"Row partition / ordered histograms\".",
+        "- `hist_exchange` (default `auto`, alias `histogram_reduce`): "
+        "data-parallel histogram collective. `psum` all-reduces the "
+        "full `[K, F, 3, B]` histogram onto every device; "
+        "`psum_scatter` reduce-scatters over the feature axis so each "
+        "device owns only its `F/ndev` slice, split-searches that "
+        "slice, and all_gathers the tiny per-leaf best-split records "
+        "(the reference's `Network::ReduceScatter` ownership model) — "
+        "per-device comms volume drops ~`ndev`x, and split-search work "
+        "too on unbundled stores. `auto` = psum_scatter when the "
+        "per-pass payload "
+        "reaches ~1 MiB (`LGBT_HIST_EXCHANGE_MIN_BYTES` override), "
+        "psum below it. See docs/Readme.md \"Histogram exchange\".",
         "",
         "## Exclusive Feature Bundling",
         "",

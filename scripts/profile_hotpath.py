@@ -52,6 +52,63 @@ def timeit(fn, *args, n=5, warmup=2):
     return (time.perf_counter() - t0) / n
 
 
+def exchange_ab(F: int, B: int, K: int) -> dict:
+    """Per-pass timing A/B of the data-parallel histogram exchange at
+    the north-star [K, F, 3, B] payload: full psum vs psum_scatter over
+    the feature axis + the [ndev, K, 11] record allgather the scattered
+    path adds (learner/rounds.py hist_exchange).  Runs over every
+    visible device of the default backend; a single-device host records
+    the skip so the chip-queue artifact is always written."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from lightgbm_tpu.learner.common import compat_shard_map
+
+    ndev = len(jax.devices())
+    rec = {"backend": jax.default_backend(), "n_devices": ndev,
+           "K": K, "F": F, "B": B,
+           "payload_mb": round(4.0 * K * F * 3 * B / 1e6, 2)}
+    if jax.default_backend() == "cpu":
+        rec["note"] = ("CPU host-platform collectives (shared-memory "
+                       "copies) — NOT the ICI comms the optimization "
+                       "targets; regenerate on a multi-chip TPU slice")
+    if ndev < 2:
+        rec["skipped"] = True
+        rec["reason"] = "single device: no exchange to measure"
+        return rec
+    Fp = ndev * ((F + ndev - 1) // ndev)
+    mesh = Mesh(np.asarray(jax.devices()).reshape(ndev), ("data",))
+
+    def ab_psum(h):
+        return jax.lax.psum(h, "data")
+
+    def ab_scatter(h):
+        s = jax.lax.psum_scatter(h, "data", scatter_dimension=1,
+                                 tiled=True)
+        # the record exchange the scattered path pays per pass
+        recs = jnp.sum(s, axis=(1, 2, 3))[:, None] * jnp.ones(11)
+        return s, jax.lax.all_gather(recs, "data")
+
+    f_psum = jax.jit(compat_shard_map(
+        ab_psum, mesh=mesh, in_specs=P(), out_specs=P()))
+    f_scat = jax.jit(compat_shard_map(
+        ab_scatter, mesh=mesh, in_specs=P(),
+        out_specs=(P(None, "data"), P())))
+    h = jnp.asarray(np.random.RandomState(0).rand(
+        K, Fp, 3, B).astype(np.float32))
+    t_psum = timeit(lambda: f_psum(h))
+    t_scat = timeit(lambda: f_scat(h)[0])
+    rec["psum_ms"] = round(t_psum * 1e3, 3)
+    rec["psum_scatter_ms"] = round(t_scat * 1e3, 3)
+    rec["speedup"] = round(t_psum / t_scat, 3)
+    rec["bytes_per_device_psum"] = 4 * K * Fp * 3 * B
+    rec["bytes_per_device_psum_scatter"] = 4 * K * (Fp // ndev) * 3 * B
+    print(f"hist exchange A/B [{K},{Fp},3,{B}] over {ndev} devices: "
+          f"psum {t_psum*1e3:.2f} ms vs psum_scatter {t_scat*1e3:.2f} ms "
+          f"({t_psum/t_scat:.2f}x)")
+    return rec
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -147,6 +204,16 @@ def main():
                                        num_bins_padded=B))
     rec["kernels"]["partition_rows_fused"] = {"ms": round(t4 * 1e3, 2)}
     print(f"partition_rows (fused): {t4*1e3:.1f} ms")
+
+    # data-parallel exchange A/B at the same [F, 3, B] shape — written
+    # to its own artifact so the chip window captures the comms win (or
+    # the single-chip skip) for free alongside the kernel profile
+    ab = exchange_ab(F, B, K)
+    ab["measured_at_commit"] = rec["measured_at_commit"]
+    with open(os.path.join(ROOT, "hist_exchange_ab_measured.json"),
+              "w") as f:
+        json.dump(ab, f, indent=1)
+    print("wrote hist_exchange_ab_measured.json")
 
     # full iteration at the same shape, bench-default precision
     import lightgbm_tpu as lgb

@@ -283,16 +283,16 @@ def test_resolve_hist_rows_and_capacity_model():
     kw = dict(num_columns=28, np_rows=100_000, bins_itemsize=4)
     cfg = config_from_params({"verbose": -1})
     assert cfg.hist_rows == "auto"
-    assert resolve_hist_rows(cfg, backend="xla", data_parallel=False,
-                             **kw) == "masked"
-    assert resolve_hist_rows(cfg, backend="pallas", data_parallel=False,
-                             **kw) == "gathered"
+    assert resolve_hist_rows(cfg, backend="xla", **kw) == "masked"
+    # auto resolves to gathered on TPU — single-device AND data-parallel
+    # shard_map (per-shard local compaction; np_rows is the per-shard
+    # row count there)
+    assert resolve_hist_rows(cfg, backend="pallas", **kw) == "gathered"
     cfg_g = config_from_params({"verbose": -1, "hist_rows": "gathered"})
-    assert resolve_hist_rows(cfg_g, backend="xla", data_parallel=False,
-                             **kw) == "gathered"
-    # shard-map stays masked until per-shard compaction lands
-    assert resolve_hist_rows(cfg_g, backend="pallas", data_parallel=True,
-                             **kw) == "masked"
+    assert resolve_hist_rows(cfg_g, backend="xla", **kw) == "gathered"
+    # masked stays reachable by explicit request
+    cfg_m = config_from_params({"verbose": -1, "hist_rows": "masked"})
+    assert resolve_hist_rows(cfg_m, backend="pallas", **kw) == "masked"
     with pytest.raises(ValueError):
         config_from_params({"hist_rows": "bogus", "verbose": -1})
     # alias
