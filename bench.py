@@ -76,6 +76,17 @@ TREE_GROWTH = os.environ.get("BENCH_TREE_GROWTH", "")
 # (auto = psum_scatter at large payloads); set psum|psum_scatter for the
 # comms A/B on multi-device runs (docs/Readme.md "Histogram exchange")
 HIST_EXCHANGE = os.environ.get("BENCH_HIST_EXCHANGE", "")
+# BENCH_SANITIZE=1 runs the timed window under the hot-path sanitizer
+# (diagnostics/sanitize.py): jax.transfer_guard("disallow") + compile
+# capture, asserting ZERO retraces and ZERO implicit device→host
+# transfers per iteration after one warmup step.  Counters land in the
+# JSON line under "sanitize".  Meaningful for the TPU learners
+# (BENCH_TREE_GROWTH=rounds, or exact→fused on chip); the CPU serial
+# learner's host loop is not a sanitize target.  The truthiness rule
+# mirrors diagnostics.sanitize.sanitize_enabled — restated here because
+# importing the package at module level would initialize jax before the
+# backend-liveness probe below.
+SANITIZE = os.environ.get("BENCH_SANITIZE", "0") not in ("0", "", "false")
 
 
 def _feature_fingerprint(X) -> str:
@@ -245,9 +256,18 @@ def main():
     rows_t0 = profiling.counter_value(profiling.HIST_ROWS_TOUCHED)
     hx_t0 = profiling.counter_value(profiling.HIST_EXCHANGE_BYTES)
     sr_t0 = profiling.counter_value(profiling.SPLIT_RECORDS_BYTES)
+    san = None
     t0 = time.perf_counter()
-    for _ in range(ITERS):
-        bst.update()
+    if SANITIZE:
+        from lightgbm_tpu.diagnostics.sanitize import HotPathSanitizer
+        san = HotPathSanitizer(warmup=1, label=f"train/{WORKLOAD}")
+        with san:
+            for _ in range(ITERS):
+                with san.step():
+                    bst.update()
+    else:
+        for _ in range(ITERS):
+            bst.update()
     # value fetch: bounds the in-flight pipelined iteration (update()
     # syncs only the PREVIOUS tree; block_until_ready can return early
     # on the tunneled remote-TPU platform)
@@ -334,6 +354,8 @@ def main():
         },
         "bundling": bundling,
     }
+    if san is not None:
+        out["sanitize"] = san.report()
     if note:
         out["note"] = note
     # full 500-iteration accuracy evidence (scripts/run_northstar.py)
@@ -346,6 +368,9 @@ def main():
             out["northstar_speedup_vs_ref"] = ns.get(
                 "speedup_vs_ref_same_host")
     print(json.dumps(out))
+    if san is not None:
+        # fail AFTER the JSON so the counters are always recorded
+        san.check()
 
 
 if __name__ == "__main__":

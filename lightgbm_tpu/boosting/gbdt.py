@@ -180,11 +180,25 @@ class GBDT:
             cap = cnt
         padded = np.full(cap, n, np.int32)
         padded[:cnt] = idx
-        self.bag_idx = jnp.asarray(padded)
+        # explicit upload: the bag redraw runs mid-loop under the
+        # sanitizer's transfer guard (jnp.asarray would be implicit)
+        self.bag_idx = jax.device_put(padded)
         self.bag_cnt = cnt
 
     def boosting_gradients(self) -> Tuple[jax.Array, jax.Array]:
         return self.objective.get_gradients(self.train_score.score)
+
+    def _shrink_dev(self) -> jax.Array:
+        """Device-resident shrinkage scalar, re-uploaded (explicitly)
+        only when the learning rate changes (reset_parameter callback):
+        passing the Python float each iteration was one implicit
+        host→device transfer per tree."""
+        cached = getattr(self, "_shrink_cache", None)
+        if cached is None or cached[0] != self.shrinkage_rate:
+            cached = (self.shrinkage_rate,
+                      jax.device_put(np.float32(self.shrinkage_rate)))
+            self._shrink_cache = cached
+        return cached[1]
 
     # ------------------------------------------------------------------
     def _flush_pending(self) -> None:
@@ -197,7 +211,10 @@ class GBDT:
         packed, slot, shrink = self._pending
         self._pending = None
         from ..learner.fused import unpack_tree_arrays, tree_arrays_to_host
-        arrs = unpack_tree_arrays(np.asarray(packed),
+        # explicit fetch (jax.device_get, not np.asarray): the packed
+        # vector was copy_to_host_async'd an iteration ago, and the
+        # explicit API keeps the transfer-guarded hot path clean
+        arrs = unpack_tree_arrays(jax.device_get(packed),
                                   self.config.num_leaves)
         tree = tree_arrays_to_host(arrs, self.train_set,
                                    self.config.num_leaves)
@@ -239,24 +256,29 @@ class GBDT:
                if self.need_bagging and self.bag_cnt < self.num_data
                else None)
         with profiling.phase("tree"):
+            # K == 1 here (_can_pipeline): reshape instead of [0] — the
+            # eager integer index lowers to dynamic_slice and uploads
+            # its start index host→device every iteration
             packed, leaf_id, arrs = self.learner.train_device(
-                gradient[0], hessian[0], bag,
+                gradient.reshape(-1), hessian.reshape(-1), bag,
                 self.bag_cnt if bag is not None else None)
         with profiling.phase("score"):
-            import jax.numpy as jnp
-            lv = jnp.clip(arrs.leaf_value * np.float32(self.shrinkage_rate),
-                          -100.0, 100.0)  # tree.h kMaxTreeOutput clamp
-            # a no-split tree must contribute zero score: the rounds
-            # learner guarantees leaf_value[0]==0 for stumps, but enforce
-            # it here so every train_device implementation is safe (the
-            # stump is popped next iteration with no score rollback)
-            lv = lv * (arrs.num_leaves >= 2)
+            from .score_updater import shrink_clip_leaves
+            lv = shrink_clip_leaves(arrs.leaf_value, arrs.num_leaves,
+                                    self._shrink_dev())
             self.train_score.add_tree_by_leaf_id_dev(leaf_id, lv, 0)
             # valid sets stay on the fast path too: traverse the device
             # TreeArrays directly (no host tree, no pipeline stall)
             for _, _, su, _ in self.valid_sets:
                 su.add_tree_arrays_dev(arrs, lv, 0)
-        packed.copy_to_host_async()
+        # the DELIBERATE transfer of the pipelined design: start the
+        # packed tree's device→host copy now so next iteration's
+        # device_get finds it done.  Marked explicitly allowed so the
+        # sanitizer's disallow-guard (diagnostics/sanitize.py) doesn't
+        # count the prefetch as an accidental sync on backends that
+        # guard device→host.
+        with jax.transfer_guard("allow"):
+            packed.copy_to_host_async()
         self.models.append(None)      # placeholder until _flush_pending
         self._pending = (packed, len(self.models) - 1, self.shrinkage_rate)
         self.iter_ += 1
@@ -284,11 +306,13 @@ class GBDT:
 
         should_continue = False
         bag = self.bag_idx if (self.need_bagging and self.bag_cnt < self.num_data) else None
+        from .score_updater import select_class_row
         for k in range(self.K):
             if self.class_need_train[k]:
                 with profiling.phase("tree"):
                     tree, leaf_id = self.learner.train(
-                        gradient[k], hessian[k], bag,
+                        select_class_row(gradient, k=k),
+                        select_class_row(hessian, k=k), bag,
                         self.bag_cnt if bag is not None else None)
             else:
                 tree = Tree(2)
@@ -343,8 +367,9 @@ class GBDT:
     # ------------------------------------------------------------------
     def _eval_one_set(self, set_name: str, su: ScoreUpdater,
                       ms: List[Metric], out: List) -> None:
-        """Device metric kernels first (scalar fetch only); host fallback
-        fetches the score vector at most once per dataset."""
+        """Device metric kernels first (lazy device scalars — see
+        _materialize_evals); host fallback fetches the score vector at
+        most once per dataset."""
         host_score = None
         for m in ms:
             res = m.eval_device(su.score, self.objective)
@@ -355,12 +380,28 @@ class GBDT:
             for nm, v in res:
                 out.append((set_name, nm, v, m.factor_to_bigger_better > 0))
 
+    @staticmethod
+    def _materialize_evals(out: List) -> List[Tuple[str, str, float, bool]]:
+        """Resolve collected (set, name, value, bigger_better) rows whose
+        values may still be 0-d device scalars with ONE batched
+        jax.device_get.  The old contract (each metric float()ing its
+        own result) cost one blocking device→host round-trip per metric
+        per iteration — the per-iteration pipeline stall the sanitizer's
+        transfer guard flags; V valid sets × M metrics now cost exactly
+        one sync."""
+        if not out:
+            return out
+        vals = jax.device_get([v for _, _, v, _ in out])
+        return [(s, n, float(v), b)
+                for (s, n, _, b), v in zip(out, vals)]
+
     def eval_train(self) -> List[Tuple[str, str, float, bool]]:
         from .. import profiling
         out: List = []
         with profiling.phase("metric"):
             self._eval_one_set("training", self.train_score,
                                self.train_metrics, out)
+            out = self._materialize_evals(out)
         return out
 
     def eval_valid(self) -> List[Tuple[str, str, float, bool]]:
@@ -369,6 +410,7 @@ class GBDT:
         with profiling.phase("metric"):
             for name, _, su, ms in self.valid_sets:
                 self._eval_one_set(name, su, ms, out)
+            out = self._materialize_evals(out)
         return out
 
     def eval_and_check_early_stopping(self, results=None) -> bool:
@@ -448,7 +490,7 @@ class GBDT:
                     chunk = np.pad(chunk, ((0, pad), (0, 0)))
                 vals = predict_trees(stack, jnp.asarray(chunk, jnp.float32),
                                      depth=depth)
-                out[k, a:b] = np.asarray(vals)[: b - a]
+                out[k, a:b] = jax.device_get(vals)[: b - a]
         return out[0] if self.K == 1 else out.T
 
     def predict_raw(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:

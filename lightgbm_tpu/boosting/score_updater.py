@@ -121,6 +121,24 @@ def traverse_tree_device(bins_t, split_feature, threshold_bin, is_cat,
 
 
 @jax.jit
+def shrink_clip_leaves(leaf_value: jax.Array, num_leaves: jax.Array,
+                       shrink: jax.Array) -> jax.Array:
+    """Shrinkage + kMaxTreeOutput clamp (tree.h: ±100) + stump zeroing,
+    fused in ONE device program.  The eager formulation uploaded the
+    shrinkage scalar and both clamp constants host→device on every
+    boosting iteration (three implicit transfers per iteration on the
+    pipelined path — the sanitizer's `sanitize/implicit_transfers`
+    counter flags them); here they are trace constants / an explicit
+    device-resident scalar (GBDT._shrink_dev)."""
+    lv = jnp.clip(leaf_value * shrink, -100.0, 100.0)
+    # a no-split tree must contribute zero score: the rounds learner
+    # guarantees leaf_value[0]==0 for stumps, but enforce it so every
+    # train_device implementation is safe (the stump is popped next
+    # iteration with no score rollback)
+    return lv * (num_leaves >= 2)
+
+
+@jax.jit
 def _add_from_leaf(score_row, leaf_idx, leaf_values):
     # one-hot matmul, not table gather: XLA's [N] gather from a leaf-sized
     # table runs at <1 GB/s on TPU (see ops/lookup.py) and cost ~65 ms per
@@ -130,11 +148,30 @@ def _add_from_leaf(score_row, leaf_idx, leaf_values):
     return score_row + val
 
 
-@jax.jit
-def _add_from_leaf_masked(score_row, leaf_id, leaf_values):
-    # out-of-bag rows carry leaf_id -1, which matches no one-hot slot and
-    # therefore contributes exactly 0.0 — no separate mask needed
-    return _add_from_leaf(score_row, leaf_id, leaf_values)
+@functools.partial(jax.jit, static_argnames=("tree_id",))
+def _add_leaf_to_row(score, leaf_id, leaf_values, *, tree_id: int):
+    """score[tree_id] += leaf_values[leaf_id], all inside ONE program.
+    Eager `score[tree_id]` / `score.at[tree_id].set(...)` lower to
+    dynamic_slice/scatter whose start index is uploaded host→device on
+    every call — one implicit transfer per boosting iteration under the
+    sanitizer's guard; a STATIC tree_id is a trace constant (the jit
+    cache holds K entries, K = trees per iteration)."""
+    val = _add_from_leaf(score[tree_id], leaf_id,
+                         leaf_values.astype(jnp.float32))
+    return score.at[tree_id].set(val)
+
+
+@functools.partial(jax.jit, static_argnames=("tree_id",))
+def _add_const_to_row(score, val, *, tree_id: int):
+    return score.at[tree_id].add(val)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def select_class_row(x, *, k: int):
+    """x[k] with a trace-constant index (the eager integer index lowers
+    to dynamic_slice and uploads its start scalar host→device on every
+    boosting iteration)."""
+    return x[k]
 
 
 class ScoreUpdater:
@@ -161,7 +198,8 @@ class ScoreUpdater:
         self.score = jnp.asarray(score)
 
     def add_constant(self, val: float, tree_id: int) -> None:
-        self.score = self.score.at[tree_id].add(np.float32(val))
+        self.score = _add_const_to_row(
+            self.score, jax.device_put(np.float32(val)), tree_id=tree_id)
 
     def _tree_leaf_idx(self, tree) -> jax.Array:
         d = tree.as_device_arrays()
@@ -177,10 +215,14 @@ class ScoreUpdater:
             self.add_constant(float(tree.leaf_value[0]) * scale, tree_id)
             return
         leaf_idx = self._tree_leaf_idx(tree)
-        lv = jnp.asarray(tree.leaf_value[: tree.max_leaves].astype(np.float32)
-                         ) * np.float32(scale)
-        self.score = self.score.at[tree_id].set(
-            _add_from_leaf(self.score[tree_id], leaf_idx, lv))
+        # scale on HOST (f32*f32 is IEEE-identical either side), then ONE
+        # explicit upload — the eager jnp.asarray + np-scalar multiply
+        # was two implicit transfers per call
+        lv = jax.device_put(
+            tree.leaf_value[: tree.max_leaves].astype(np.float32)
+            * np.float32(scale))
+        self.score = _add_leaf_to_row(self.score, leaf_idx, lv,
+                                      tree_id=tree_id)
 
     def add_tree_arrays_dev(self, arrs, leaf_values: jax.Array,
                             tree_id: int) -> None:
@@ -191,9 +233,8 @@ class ScoreUpdater:
             self.bins_t, arrs.split_feature, arrs.threshold_bin,
             arrs.is_cat, arrs.left_child, arrs.right_child, arrs.num_leaves,
             self.feat_tbl)
-        self.score = self.score.at[tree_id].set(
-            _add_from_leaf(self.score[tree_id], leaf_idx,
-                           leaf_values.astype(jnp.float32)))
+        self.score = _add_leaf_to_row(self.score, leaf_idx, leaf_values,
+                                      tree_id=tree_id)
 
     def add_tree_by_leaf_id_dev(self, leaf_id: jax.Array,
                                 leaf_values: jax.Array, tree_id: int
@@ -201,18 +242,22 @@ class ScoreUpdater:
         """Leaf-partition score update with DEVICE leaf values (shrinkage
         pre-applied) — no host tree needed; used by the pipelined
         training path."""
-        self.score = self.score.at[tree_id].set(
-            _add_from_leaf(self.score[tree_id], leaf_id,
-                           leaf_values.astype(jnp.float32)))
+        self.score = _add_leaf_to_row(self.score, leaf_id, leaf_values,
+                                      tree_id=tree_id)
 
     def add_tree_by_leaf_id(self, tree, leaf_id: jax.Array, tree_id: int
                             ) -> None:
         """Leaf-partition fast path for the training set
-        (serial_tree_learner.h:52-64): leaf_id -1 rows (out-of-bag) are
-        skipped — callers follow with add_tree for OOB when bagging."""
-        lv = jnp.asarray(tree.leaf_value[: tree.max_leaves].astype(np.float32))
-        self.score = self.score.at[tree_id].set(
-            _add_from_leaf_masked(self.score[tree_id], leaf_id, lv))
+        (serial_tree_learner.h:52-64): leaf_id -1 rows (out-of-bag) match
+        no one-hot slot and contribute exactly 0.0 — callers follow with
+        add_tree for OOB when bagging."""
+        lv = jax.device_put(
+            tree.leaf_value[: tree.max_leaves].astype(np.float32))
+        self.score = _add_leaf_to_row(self.score, leaf_id, lv,
+                                      tree_id=tree_id)
 
     def get(self) -> np.ndarray:
-        return np.asarray(self.score, np.float64)
+        """Fetch the whole [K, N] score to host — the ONE deliberate
+        bulk sync of the host-metric fallback path (explicit, so the
+        sanitizer's guard distinguishes it from accidental syncs)."""
+        return jax.device_get(self.score).astype(np.float64)

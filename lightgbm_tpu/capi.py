@@ -416,7 +416,7 @@ class CApiBooster:
         name, _, su, ms = g.valid_sets[data_idx - 1]
         out: List = []
         g._eval_one_set(name, su, ms, out)
-        return [v for _, _, v, _ in out]
+        return [v for _, _, v, _ in g._materialize_evals(out)]
 
     def inner_predict_len(self, data_idx: int) -> int:
         """Length of GetPredict's result WITHOUT materializing it

@@ -1,0 +1,6 @@
+"""Hot-path diagnostics: graftlint static analysis (`lint`) and the
+runtime retrace/transfer sanitizer (`sanitize`).
+
+`lint` is stdlib-only (no jax import) so the CI gate stays cheap;
+`sanitize` imports jax lazily inside the context manager.
+"""

@@ -1,0 +1,955 @@
+"""graftlint — AST static analysis for JAX hot-path hazards.
+
+Four PRs of hot-path work made performance depend on invariants the
+Python type system cannot see: jitted tree builders must not retrace
+across boosting iterations, no tracer may leak to host mid-loop, and
+every per-iteration implicit device→host transfer is a pipeline stall
+(the dominant scaling tax of the GPU boosting literature,
+arXiv:1706.08359 §5 / arXiv:1806.11248 §4).  This pass codifies those
+invariants the way scripts/check_config_coverage.py codifies config
+liveness: violations fail in CI, not in the next on-chip bench window.
+
+Rules
+-----
+- ``host-sync``: device→host synchronization hazards.  ``.item()``
+  anywhere; ``float()``/``int()``/``bool()`` or ``np.asarray``/
+  ``np.array`` applied to a device value; implicit ``__bool__``
+  (``if tracer:`` / ``while tracer:`` / ``assert tracer``) inside
+  functions reachable from jit.  Device values are found by a local
+  dataflow: names assigned from ``jnp.*``/``lax.*``/``jax.*`` calls or
+  from calls into known-jitted package functions (``jax.device_get``
+  results are host values and exempt — it is the sanctioned, batchable
+  fetch).
+- ``retrace-hazard``: per-iteration recompile/upload hazards.  Call
+  sites of known-jitted functions passing a ``Config``-derived
+  attribute (``cfg.x`` / ``config.x`` / ``self.config.x``) to a
+  parameter not in ``static_argnames`` (config scalars are fixed per
+  run: bake them static or close over them with ``functools.partial``
+  so a changed config is an intentional retrace, not a silent per-call
+  upload); ``print``/``log.*`` calls and f-strings formatting device
+  values inside traced bodies (trace-time host effects).
+- ``dtype-drift``: float64 leaking into traced code with x64 disabled.
+  ``np.float64``/``jnp.float64`` casts, ``dtype="float64"``,
+  ``astype(float64)``, and float literals outside float32 range (they
+  silently become ``0``/``inf`` when the tracer downcasts).
+- ``nondeterminism``: ``time.*`` clocks and ``random``/``np.random``
+  draws inside traced bodies — they execute at trace time, bake one
+  arbitrary value into the compiled program, and make retraces
+  unreproducible.
+
+Traced-region discovery: jit roots are ``@jax.jit`` /
+``functools.partial(jax.jit, static_argnames=...)`` decorators,
+``jax.jit(f)`` / ``jax.jit(functools.partial(f, ...))`` /
+``jax.jit(compat_shard_map(f, ...))`` call sites, and bodies handed to
+``lax.{fori_loop,while_loop,scan,cond,switch}`` / ``jax.vmap`` /
+``shard_map`` (lax control flow traces its body even outside jit).
+Reachability then propagates through same-package calls (local names,
+``self.method``, and ``from ..x import y`` imports).
+
+Suppressions
+------------
+Inline, on the finding line or the line above, with a REQUIRED reason::
+
+    x = float(total)  # graftlint: allow(host-sync) — chosen sync point
+
+or a reviewed allowlist entry in scripts/lint_allowlist.txt
+(``path::rule::qualname — reason``), mirroring the config-coverage
+allowlist: adding one is a conscious review decision.
+
+Run: ``python scripts/run_lint.py`` (nonzero exit on findings); tier-1
+runs it from tests/test_lint_clean.py.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+RULES = ("host-sync", "retrace-hazard", "dtype-drift", "nondeterminism")
+
+# float32 finite range; literals outside it (except 0) drift under jit
+_F32_MAX = 3.4028235e38
+_F32_TINY = 1.1754944e-38
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*allow\(\s*([a-z-]+(?:\s*,\s*[a-z-]+)*)\s*\)"
+    r"\s*(?:[-—–:]+\s*)?(.*)")
+
+_DEVICE_MODULES = {"jnp", "lax"}          # jnp.x(...) / lax.x(...)
+_DEVICE_JAX_SUBMODULES = {"lax", "nn", "numpy", "random", "scipy"}
+# fetch APIs whose results are HOST values (the sanctioned sync points)
+_HOST_FETCHES = {("jax", "device_get")}
+_TRACE_WRAPPER_FN_ARGS = {
+    # callee suffix -> 0-based positions of traced-function arguments
+    "fori_loop": (2,),
+    "while_loop": (0, 1),
+    "scan": (0,),
+    "cond": (1, 2),
+    "switch": (1,),
+    "vmap": (0,),
+    "checkpoint": (0,),
+    "remat": (0,),
+    "shard_map": (0,),
+    "compat_shard_map": (0,),
+}
+
+
+@dataclass
+class Finding:
+    path: str            # repo-relative
+    line: int
+    rule: str
+    message: str
+    qualname: str        # enclosing function ('<module>' at top level)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: {self.rule}: {self.message} "
+                f"[in {self.qualname}]")
+
+
+@dataclass(eq=False)          # identity hash: one node, one entry
+class FuncInfo:
+    module: str
+    qualname: str
+    node: ast.AST                      # FunctionDef / AsyncFunctionDef
+    params: Tuple[str, ...]
+    statics: Set[str] = field(default_factory=set)
+    tracer_params: Set[str] = field(default_factory=set)
+    traced: bool = False
+    is_jit_root: bool = False          # has its own jit cache + statics
+
+
+@dataclass
+class ModuleInfo:
+    name: str
+    path: str                          # repo-relative
+    tree: ast.Module
+    lines: List[str]
+    funcs: Dict[str, FuncInfo] = field(default_factory=dict)
+    # local name -> (module, name) for from-imports; name -> module for
+    # module imports/aliases
+    imports: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    mod_aliases: Dict[str, str] = field(default_factory=dict)
+    # attribute names assigned from device expressions anywhere in the
+    # module (`self.score = jnp.asarray(...)`) — lets the dataflow see
+    # `float(self.score)` through object state, not just local names —
+    # and attrs assigned HOST values (`self.label = np.asarray(...)`):
+    # a name that appears in both is ambiguous across classes and is
+    # excluded from the package-wide registry
+    device_attrs: Set[str] = field(default_factory=set)
+    host_attrs: Set[str] = field(default_factory=set)
+
+
+def _devicey_chain(chain: Optional[Tuple[str, ...]]) -> bool:
+    """True when a call through this attribute chain returns a device
+    value (jnp.*/lax.*/jax.* constructors and transforms); False for the
+    host-returning introspection and fetch APIs."""
+    if not chain:
+        return False
+    if chain[:2] == ("jax", "device_get"):
+        return False                           # the sanctioned fetch
+    if chain[0] in _DEVICE_MODULES:
+        return chain[-1] not in ("dtype", "result_type", "issubdtype",
+                                 "ndim", "shape", "size")
+    if chain[0] == "jax" and (len(chain) == 2
+                              or chain[1] in _DEVICE_JAX_SUBMODULES):
+        return chain[-1] not in ("device_get", "process_count",
+                                 "process_index", "devices",
+                                 "local_devices", "default_backend")
+    return False
+
+
+def _attr_chain(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """('jax','lax','fori_loop') for jax.lax.fori_loop; None otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def _static_argnames_from_call(call: ast.Call) -> Set[str]:
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            out: Set[str] = set()
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                out.add(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                for e in v.elts:
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                        out.add(e.value)
+            return out
+    return set()
+
+
+def _is_jit_expr(node: ast.AST) -> Optional[Set[str]]:
+    """Static-argname set when `node` evaluates to a jit transform
+    (jax.jit / jit / functools.partial(jax.jit, ...)), else None."""
+    chain = _attr_chain(node)
+    if chain and chain[-1] == "jit" and (len(chain) == 1 or chain[0] == "jax"):
+        return set()
+    if isinstance(node, ast.Call):
+        chain = _attr_chain(node.func)
+        if chain and chain[-1] == "partial":
+            if node.args and _is_jit_expr(node.args[0]) is not None:
+                return _static_argnames_from_call(node)
+        if chain and chain[-1] == "jit" and (len(chain) == 1
+                                             or chain[0] == "jax"):
+            return _static_argnames_from_call(node)
+    return None
+
+
+def _module_name_for(path: str, root: str) -> str:
+    rel = os.path.relpath(path, root)
+    mod = rel[:-3].replace(os.sep, ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+def _resolve_relative(module: str, node: ast.ImportFrom) -> Optional[str]:
+    if node.level == 0:
+        return node.module
+    parts = module.split(".")
+    if node.level > len(parts):
+        return None
+    base = parts[: len(parts) - node.level]
+    if node.module:
+        base = base + node.module.split(".")
+    return ".".join(base) if base else None
+
+
+class _ModuleIndexer(ast.NodeVisitor):
+    """Pass 1: function defs, imports, direct jit roots, local aliases."""
+
+    def __init__(self, mi: ModuleInfo):
+        self.mi = mi
+        self.stack: List[str] = []
+        # function-local aliases: name -> (funcname, partial_statics|None)
+        self.aliases: Dict[str, Tuple[str, Optional[Set[str]]]] = {}
+
+    # -- imports --------------------------------------------------------
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            self.mi.mod_aliases[a.asname or a.name.split(".")[0]] = a.name
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        src = _resolve_relative(self.mi.name, node)
+        if src is None:
+            return
+        for a in node.names:
+            if a.name == "*":
+                continue
+            local = a.asname or a.name
+            self.mi.imports[local] = (src, a.name)
+
+    # -- functions ------------------------------------------------------
+    def _visit_func(self, node) -> None:
+        qual = ".".join(self.stack + [node.name])
+        params = tuple(
+            a.arg for a in (node.args.posonlyargs + node.args.args
+                            + node.args.kwonlyargs))
+        fi = FuncInfo(self.mi.name, qual, node, params)
+        for dec in node.decorator_list:
+            statics = _is_jit_expr(dec)
+            if statics is not None:
+                fi.traced = fi.is_jit_root = True
+                fi.statics = statics
+                fi.tracer_params = set(params) - statics
+        self.mi.funcs[qual] = fi
+        # bare-name index for intra-module resolution (last def wins;
+        # nested helpers are usually unique per module in this codebase)
+        self.mi.funcs.setdefault(node.name, fi)
+        if self.mi.funcs[node.name].qualname != qual and "." not in qual:
+            self.mi.funcs[node.name] = fi     # top level shadows nested
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # track `f = some_func` / `f = functools.partial(some_func, ...)`
+        if len(node.targets) == 1 and isinstance(node.targets[0], ast.Name):
+            tgt = node.targets[0].id
+            ref = _callable_ref(node.value)
+            if ref is not None:
+                self.aliases[tgt] = ref
+        # track `self.x = jnp.asarray(...)`-style device-attribute state
+        # vs `self.x = np.asarray(...)`-style host state
+        if isinstance(node.value, ast.Call):
+            chain = _attr_chain(node.value.func)
+            if _devicey_chain(chain):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute):
+                        self.mi.device_attrs.add(t.attr)
+            elif chain and (chain[0] in ("np", "numpy")
+                            or chain[:2] == ("jax", "device_get")):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute):
+                        self.mi.host_attrs.add(t.attr)
+        self.generic_visit(node)
+
+
+def _callable_ref(expr: ast.AST) -> Optional[Tuple[str, Optional[Set[str]]]]:
+    """(function-name, bound-statics) when `expr` is a bare function
+    reference or functools.partial(fn, ...).  bound-statics is a set of
+    parameter names bound by the partial (empty for a bare reference) or
+    None when the bindings cannot be determined (a ``**kw`` splat) — in
+    that case callers must NOT assume the remaining parameters are
+    tracers."""
+    if isinstance(expr, ast.Name):
+        return (expr.id, set())
+    if isinstance(expr, ast.Call):
+        chain = _attr_chain(expr.func)
+        if chain and chain[-1] == "partial" and expr.args:
+            inner = expr.args[0]
+            if isinstance(inner, ast.Name):
+                bound: Optional[Set[str]] = set()
+                for kw in expr.keywords:
+                    if kw.arg is None:       # **kw splat: bindings unknown
+                        bound = None
+                        break
+                    bound.add(kw.arg)
+                return (inner.id, bound)
+    return None
+
+
+class Package:
+    """Parsed package + traced-region call graph."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self.modules: Dict[str, ModuleInfo] = {}
+        self._alias_maps: Dict[str, Dict[str, Tuple[str, Optional[Set[str]]]]] = {}
+
+    # -- loading --------------------------------------------------------
+    def add_file(self, path: str) -> None:
+        with open(path) as fh:
+            src = fh.read()
+        mod = _module_name_for(path, self.root)
+        mi = ModuleInfo(mod, os.path.relpath(path, self.root),
+                        ast.parse(src, filename=path), src.splitlines())
+        ix = _ModuleIndexer(mi)
+        ix.visit(mi.tree)
+        self.modules[mod] = mi
+        self._alias_maps[mod] = ix.aliases
+
+    def add_tree(self, pkg_dir: str) -> None:
+        for dirpath, _dirs, files in os.walk(pkg_dir):
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    self.add_file(os.path.join(dirpath, f))
+
+    def device_attrs(self) -> Set[str]:
+        """Package-wide attribute names assigned ONLY from device
+        expressions: an attr any class also assigns a host value
+        ('label': device in objectives, numpy in metrics) is ambiguous
+        across objects and excluded (built once after loading)."""
+        if not hasattr(self, "_device_attrs"):
+            dev: Set[str] = set()
+            host: Set[str] = set()
+            for mi in self.modules.values():
+                dev |= mi.device_attrs
+                host |= mi.host_attrs
+            self._device_attrs = dev - host
+        return self._device_attrs
+
+    # -- resolution -----------------------------------------------------
+    def resolve(self, module: str, name: str) -> Optional[FuncInfo]:
+        mi = self.modules.get(module)
+        if mi is None:
+            return None
+        if name in mi.funcs:
+            return mi.funcs[name]
+        if name in mi.imports:
+            src_mod, src_name = mi.imports[name]
+            if src_mod != module:
+                return self.resolve(src_mod, src_name)
+        alias = self._alias_maps.get(module, {}).get(name)
+        if alias is not None:
+            return self.resolve(module, alias[0])
+        return None
+
+    def resolve_callee(self, mi: ModuleInfo, qual: str,
+                       func: ast.AST) -> Optional[FuncInfo]:
+        """Resolve a Call callee to a package FuncInfo: bare name,
+        self.method, or imported-module attribute."""
+        if isinstance(func, ast.Name):
+            return self.resolve(mi.name, func.id)
+        chain = _attr_chain(func)
+        if not chain or len(chain) != 2:
+            return None
+        base, attr = chain
+        if base == "self":
+            # method in the same class: replace the last qualname part
+            parts = qual.split(".")
+            for cut in range(len(parts) - 1, 0, -1):
+                cand = ".".join(parts[:cut] + [attr])
+                if cand in mi.funcs:
+                    return mi.funcs[cand]
+            return None
+        if base in mi.mod_aliases:
+            return self.resolve(mi.mod_aliases[base], attr)
+        if base in mi.imports:          # `from .ops import eval as deval`
+            src_mod, src_name = mi.imports[base]
+            return self.resolve(f"{src_mod}.{src_name}", attr)
+        return None
+
+    # -- traced-region discovery ---------------------------------------
+    def mark_traced(self) -> None:
+        work: List[FuncInfo] = [fi for mi in self.modules.values()
+                                for fi in set(mi.funcs.values()) if fi.traced]
+
+        def mark(fi: Optional[FuncInfo], tracer_params: bool = False,
+                 statics: Optional[Set[str]] = None) -> None:
+            if fi is None:
+                return
+            new_statics = statics or set()
+            if not fi.traced:
+                fi.traced = True
+                if tracer_params:
+                    fi.tracer_params = set(fi.params) - new_statics
+                fi.statics |= new_statics
+                work.append(fi)
+            elif tracer_params and not fi.tracer_params and not fi.is_jit_root:
+                fi.tracer_params = set(fi.params) - new_statics
+                fi.statics |= new_statics
+
+        # seed: jit()/shard_map()/lax-control-flow call sites anywhere.
+        # A partial() with a **splat hides which parameters are bound
+        # (extra is None): the body is traced but parameters must not be
+        # assumed tracers, or every static config branch would flag.
+        for mi in self.modules.values():
+            for node in ast.walk(mi.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                statics = _is_jit_expr(node.func)
+                if statics is not None and node.args:
+                    for fn, extra in self._fn_refs(mi, node.args[0]):
+                        mark(fn, tracer_params=extra is not None,
+                             statics=statics | (extra or set()))
+                    continue
+                chain = _attr_chain(node.func)
+                if chain and chain[-1] in _TRACE_WRAPPER_FN_ARGS:
+                    for pos in _TRACE_WRAPPER_FN_ARGS[chain[-1]]:
+                        if pos < len(node.args):
+                            for fn, extra in self._fn_refs(mi,
+                                                           node.args[pos]):
+                                mark(fn, tracer_params=extra is not None,
+                                     statics=extra or set())
+
+        # propagate through same-package calls from traced bodies
+        seen: Set[Tuple[str, str]] = set()
+        while work:
+            fi = work.pop()
+            key = (fi.module, fi.qualname)
+            if key in seen:
+                continue
+            seen.add(key)
+            mi = self.modules[fi.module]
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Call):
+                    target = self.resolve_callee(mi, fi.qualname, node.func)
+                    if target is not None and not target.traced:
+                        mark(target)
+                    # functools.partial(fn, ...) inside traced bodies:
+                    # fn will be called traced (lax.cond branch tables)
+                    ref = _callable_ref(node)
+                    if ref is not None and isinstance(node, ast.Call) \
+                            and ref[0] != getattr(node.func, "id", None):
+                        mark(self.resolve(mi.name, ref[0]),
+                             tracer_params=False)
+
+    def _fn_refs(self, mi: ModuleInfo, expr: ast.AST
+                 ) -> Iterable[Tuple[Optional[FuncInfo], Optional[Set[str]]]]:
+        """FuncInfos referenced by a jit/shard_map/lax-wrapper argument:
+        a name, functools.partial(name, ...), a [list] of names (switch),
+        or a nested shard_map/partial call."""
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            for e in expr.elts:
+                yield from self._fn_refs(mi, e)
+            return
+        ref = _callable_ref(expr)
+        if ref is not None:
+            name, bound = ref
+            # chase local `fn = functools.partial(f, **kw)` aliases,
+            # merging binding knowledge: an unknown (**splat) binding
+            # anywhere in the chain means parameters must not be
+            # assumed tracers
+            amap = self._alias_maps.get(mi.name, {})
+            hops: Set[str] = set()
+            while name in amap and name not in hops:
+                hops.add(name)
+                aname, abound = amap[name]
+                bound = (None if bound is None or abound is None
+                         else bound | abound)
+                name = aname
+            yield self.resolve(mi.name, name), bound
+            return
+        if isinstance(expr, ast.Call):     # jit(compat_shard_map(fn, ...))
+            chain = _attr_chain(expr.func)
+            if chain and chain[-1] in _TRACE_WRAPPER_FN_ARGS and expr.args:
+                yield from self._fn_refs(mi, expr.args[0])
+
+
+# ---------------------------------------------------------------------------
+# rule checks
+# ---------------------------------------------------------------------------
+
+
+class _Dataflow:
+    """Per-function device-value tracking (names only, straight-line
+    approximation: later assignments overwrite earlier ones)."""
+
+    def __init__(self, pkg: Package, mi: ModuleInfo, fi: FuncInfo):
+        self.pkg = pkg
+        self.mi = mi
+        self.fi = fi
+        self.devicey_names: Set[str] = set(fi.tracer_params)
+
+    def is_devicey(self, expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in self.devicey_names
+        if isinstance(expr, ast.Call):
+            chain = _attr_chain(expr.func)
+            if chain:
+                if chain[:2] == ("jax", "device_get"):
+                    return False                       # sanctioned fetch
+                if _devicey_chain(chain):
+                    return True
+                if chain[0] in _DEVICE_MODULES or chain[0] == "jax":
+                    return False                       # host-returning API
+            # only jit ROOTS reliably return device arrays; a merely
+            # reachable-from-jit helper called with host args at trace
+            # time returns host values (gather_scratch_capacity etc.)
+            target = self.pkg.resolve_callee(self.mi, self.fi.qualname,
+                                             expr.func)
+            if target is not None and target.is_jit_root:
+                return True
+            # method call on a devicey value: x.sum(), x.reshape(...)
+            if isinstance(expr.func, ast.Attribute):
+                return self.is_devicey(expr.func.value)
+            return False
+        if isinstance(expr, ast.BinOp):
+            return self.is_devicey(expr.left) or self.is_devicey(expr.right)
+        if isinstance(expr, ast.UnaryOp):
+            return self.is_devicey(expr.operand)
+        if isinstance(expr, ast.Compare):
+            # identity/containment tests (`x is None`) never call the
+            # tracer's __bool__ and return a host bool — not a hazard
+            if all(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                   for op in expr.ops):
+                return False
+            return self.is_devicey(expr.left) or any(
+                self.is_devicey(c) for c in expr.comparators)
+        if isinstance(expr, ast.BoolOp):
+            return any(self.is_devicey(v) for v in expr.values)
+        if isinstance(expr, ast.Subscript):
+            return self.is_devicey(expr.value)
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in ("shape", "ndim", "dtype", "size", "itemsize",
+                             "nbytes", "at"):
+                return expr.attr == "at" and self.is_devicey(expr.value)
+            # object state: an attribute assigned from a device
+            # expression (self.score = jnp.asarray(...)) is a device
+            # value wherever it is read — float(self.score) is the same
+            # stall as float(score).  Scoping controls collisions: a
+            # direct `self.x` read matches only attrs registered in the
+            # SAME module (objectives' device self.label must not taint
+            # metrics' host self.label); a multi-hop read through
+            # another object (`self.train_score.score`) is cross-class
+            # by construction and consults the package-wide registry.
+            b, levels = expr.value, 1
+            while isinstance(b, ast.Attribute):
+                b, levels = b.value, levels + 1
+            if isinstance(b, ast.Name) and b.id == "self":
+                if levels == 1 and expr.attr in (self.mi.device_attrs
+                                                 - self.mi.host_attrs):
+                    return True
+                if levels >= 2 and expr.attr in self.pkg.device_attrs():
+                    return True
+            return self.is_devicey(expr.value)
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return any(self.is_devicey(e) for e in expr.elts)
+        if isinstance(expr, ast.IfExp):
+            return self.is_devicey(expr.body) or self.is_devicey(expr.orelse)
+        return False
+
+    def note_assign(self, node: ast.AST) -> None:
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+            value = node.value
+        elif isinstance(node, ast.AugAssign):
+            targets = [node.target]
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+            value = node.value
+        else:
+            return
+        dev = self.is_devicey(value)
+        for t in targets:
+            if isinstance(t, ast.Name):
+                if dev:
+                    self.devicey_names.add(t.id)
+                else:
+                    self.devicey_names.discard(t.id)
+
+
+def _has_float64(expr: ast.AST) -> Optional[ast.AST]:
+    for n in ast.walk(expr):
+        chain = _attr_chain(n)
+        if chain and chain[-1] in ("float64", "double") and chain[0] in (
+                "np", "numpy", "jnp"):
+            return n
+        if isinstance(n, ast.Constant) and n.value in ("float64", "double"):
+            return n
+    return None
+
+
+def _config_attr(expr: ast.AST) -> Optional[str]:
+    """Name of a Config field read inside `expr` (cfg.x / config.x /
+    self.config.x / anything.config.x), or None."""
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Attribute):
+            base = n.value
+            if isinstance(base, ast.Name) and base.id in ("cfg", "config"):
+                return n.attr
+            if isinstance(base, ast.Attribute) and base.attr == "config":
+                return n.attr
+    return None
+
+
+class _Checker(ast.NodeVisitor):
+    """Rule checks over one function body (or module top level)."""
+
+    def __init__(self, pkg: Package, mi: ModuleInfo, fi: Optional[FuncInfo],
+                 findings: List[Finding]):
+        self.pkg = pkg
+        self.mi = mi
+        self.fi = fi
+        self.traced = fi is not None and fi.traced
+        self.qual = fi.qualname if fi is not None else "<module>"
+        self.flow = _Dataflow(pkg, mi, fi) if fi is not None else None
+        self.findings = findings
+
+    # -- helpers --------------------------------------------------------
+    def _emit(self, node: ast.AST, rule: str, msg: str) -> None:
+        self.findings.append(Finding(self.mi.path, node.lineno, rule, msg,
+                                     self.qual))
+
+    def _devicey(self, expr: ast.AST) -> bool:
+        return self.flow is not None and self.flow.is_devicey(expr)
+
+    # -- assignments feed the dataflow ---------------------------------
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.generic_visit(node)
+        if self.flow is not None:
+            self.flow.note_assign(node)
+
+    visit_AugAssign = visit_Assign
+    visit_AnnAssign = visit_Assign
+
+    # -- host-sync ------------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        chain = _attr_chain(func)
+        # .item(): a one-element device→host sync wherever it appears
+        if isinstance(func, ast.Attribute) and func.attr == "item" \
+                and not node.args:
+            self._emit(node, "host-sync",
+                       ".item() forces a blocking device→host sync; "
+                       "batch scalar fetches with jax.device_get at the "
+                       "loop boundary")
+        # float()/int()/bool() of a device value
+        if isinstance(func, ast.Name) and func.id in ("float", "int", "bool") \
+                and len(node.args) == 1 and self._devicey(node.args[0]):
+            self._emit(node, "host-sync",
+                       f"{func.id}() on a device value blocks on a "
+                       "device→host transfer; keep it on device or fetch "
+                       "explicitly (batched) with jax.device_get")
+        # np.asarray / np.array of a device value
+        if chain and chain[0] in ("np", "numpy", "onp") \
+                and chain[-1] in ("asarray", "array", "ascontiguousarray") \
+                and node.args and self._devicey(node.args[0]):
+            self._emit(node, "host-sync",
+                       f"{'.'.join(chain)} of a device value is an "
+                       "implicit device→host transfer; use jax.device_get "
+                       "(explicit, transfer-guard-clean, batchable)")
+        if self.traced:
+            self._check_traced_call(node, chain)
+        self._check_config_static(node)
+        self.generic_visit(node)
+
+    def _check_traced_call(self, node: ast.Call,
+                           chain: Optional[Tuple[str, ...]]) -> None:
+        func = node.func
+        # print / logging inside traced code
+        if isinstance(func, ast.Name) and func.id == "print":
+            self._emit(node, "retrace-hazard",
+                       "print() inside traced code runs at trace time "
+                       "only (or forces a callback); use "
+                       "jax.debug.print or hoist out of the jit region")
+        if chain and len(chain) >= 2 and chain[0] in ("log", "logging",
+                                                      "logger", "Log"):
+            self._emit(node, "retrace-hazard",
+                       f"{'.'.join(chain)}() inside traced code is a "
+                       "trace-time host effect; hoist logging out of the "
+                       "jit region")
+        # nondeterminism
+        if chain:
+            if chain[0] == "time" and chain[-1] in (
+                    "time", "perf_counter", "monotonic", "time_ns",
+                    "process_time"):
+                self._emit(node, "nondeterminism",
+                           f"{'.'.join(chain)}() in traced code executes "
+                           "once at trace time and bakes a stale constant "
+                           "into the compiled program")
+            if chain[0] == "random" or chain[:2] in (("np", "random"),
+                                                     ("numpy", "random")):
+                self._emit(node, "nondeterminism",
+                           f"{'.'.join(chain)}() in traced code draws at "
+                           "trace time (one arbitrary constant per "
+                           "compile); thread a jax.random key instead")
+        # dtype-drift: astype(float64)
+        if isinstance(func, ast.Attribute) and func.attr == "astype" \
+                and node.args and _has_float64(node.args[0]) is not None:
+            self._emit(node, "dtype-drift",
+                       "astype(float64) inside traced code silently "
+                       "downcasts to f32 with x64 disabled; pin the "
+                       "intended dtype explicitly")
+
+    def _check_config_static(self, node: ast.Call) -> None:
+        """Config-derived Python value passed to a jitted function's
+        traced (non-static) parameter."""
+        if self.fi is None:
+            target = None
+        else:
+            target = self.pkg.resolve_callee(self.mi, self.qual, node.func)
+        if target is None or not target.is_jit_root:
+            return
+        params = list(target.params)
+        for i, arg in enumerate(node.args):
+            fieldname = _config_attr(arg)
+            if fieldname is None:
+                continue
+            pname = params[i] if i < len(params) else f"arg{i}"
+            if pname not in target.statics:
+                self._emit(
+                    arg, "retrace-hazard",
+                    f"Config field '{fieldname}' flows into jitted "
+                    f"'{target.qualname}' parameter '{pname}' which is "
+                    "not in static_argnames: a per-call scalar upload, "
+                    "and a silent retrace hazard if it reaches shape or "
+                    "branch logic; declare it static or bind it with "
+                    "functools.partial")
+        for kw in node.keywords:
+            if kw.arg is None:
+                continue
+            fieldname = _config_attr(kw.value)
+            if fieldname is not None and kw.arg not in target.statics:
+                self._emit(
+                    kw.value, "retrace-hazard",
+                    f"Config field '{fieldname}' flows into jitted "
+                    f"'{target.qualname}' parameter '{kw.arg}' which is "
+                    "not in static_argnames; declare it static or bind "
+                    "it with functools.partial")
+
+    # -- implicit __bool__ on tracers ----------------------------------
+    def _check_test(self, test: ast.AST, kind: str) -> None:
+        if self.traced and self._devicey(test):
+            self._emit(test, "host-sync",
+                       f"`{kind}` on a traced value calls __bool__ on a "
+                       "tracer (TracerBoolConversionError under jit, a "
+                       "blocking sync when eager); use lax.cond/jnp.where")
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_test(node.test, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_test(node.test, "while")
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        self._check_test(node.test, "ternary if")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        self._check_test(node.test, "assert")
+        self.generic_visit(node)
+
+    # -- f-strings formatting device values -----------------------------
+    def visit_JoinedStr(self, node: ast.JoinedStr) -> None:
+        if self.traced:
+            for v in node.values:
+                if isinstance(v, ast.FormattedValue) and self._devicey(v.value):
+                    self._emit(node, "retrace-hazard",
+                               "f-string formats a traced value: renders "
+                               "the tracer repr at trace time (and forces "
+                               "a sync when eager); use jax.debug.print")
+                    break
+        self.generic_visit(node)
+
+    # -- dtype drift on literals / dtype kwargs -------------------------
+    def visit_Constant(self, node: ast.Constant) -> None:
+        if self.traced and isinstance(node.value, float) and node.value != 0.0:
+            a = abs(node.value)
+            if a > _F32_MAX or a < _F32_TINY:
+                self._emit(node, "dtype-drift",
+                           f"float literal {node.value!r} is outside "
+                           "float32 range and becomes 0/inf when the "
+                           "tracer downcasts with x64 disabled")
+
+    def visit_keyword(self, node: ast.keyword) -> None:
+        if self.traced and node.arg == "dtype" \
+                and _has_float64(node.value) is not None:
+            self._emit(node.value, "dtype-drift",
+                       "dtype=float64 inside traced code is quietly f32 "
+                       "with x64 disabled; pin float32 (or int32) "
+                       "explicitly")
+        self.generic_visit(node)
+
+    # keep nested defs inside their own _Checker run
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if self.fi is not None and node is not self.fi.node:
+            return                      # separate FuncInfo covers it
+        for d in node.decorator_list:
+            self.visit(d)
+        for stmt in node.body:
+            self.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self.visit(node.body)
+
+
+# np.float64(...) calls in traced code (checker-level, needs chain only)
+def _np_float64_calls(fi: FuncInfo, mi: ModuleInfo,
+                      findings: List[Finding]) -> None:
+    for node in ast.walk(fi.node):
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if chain and chain[-1] in ("float64", "double") \
+                    and chain[0] in ("np", "numpy", "jnp"):
+                findings.append(Finding(
+                    mi.path, node.lineno, "dtype-drift",
+                    "np.float64 cast inside traced code silently becomes "
+                    "f32 with x64 disabled; pin float32 or hoist to host",
+                    fi.qualname))
+
+
+# ---------------------------------------------------------------------------
+# suppression handling
+# ---------------------------------------------------------------------------
+
+
+def _suppressions_for(lines: Sequence[str], lineno: int
+                      ) -> Optional[Tuple[Set[str], str]]:
+    """(rules, reason) from a graftlint comment on `lineno` or the line
+    above (1-indexed); None when no suppression applies."""
+    for ln in (lineno, lineno - 1):
+        if 1 <= ln <= len(lines):
+            m = _SUPPRESS_RE.search(lines[ln - 1])
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",")}
+                return rules, m.group(2).strip()
+    return None
+
+
+def load_allowlist(path: str) -> Dict[Tuple[str, str, str], str]:
+    """path::rule::qualname -> reason entries from the reviewed file."""
+    out: Dict[Tuple[str, str, str], str] = {}
+    if not os.path.exists(path):
+        return out
+    with open(path) as fh:
+        for raw in fh:
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            body, _, reason = line.partition("—")
+            if not reason:
+                body, _, reason = line.partition(" - ")
+            parts = [p.strip() for p in body.strip().split("::")]
+            if len(parts) == 3:
+                out[(parts[0], parts[1], parts[2])] = reason.strip()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def lint_paths(paths: Sequence[str], root: str,
+               allowlist: Optional[Dict[Tuple[str, str, str], str]] = None
+               ) -> List[Finding]:
+    """Run every rule over `paths` (files or directories).  Returns
+    unsuppressed findings; suppressions without a reason are findings
+    themselves (`suppression` rule)."""
+    pkg = Package(root)
+    for p in paths:
+        if os.path.isdir(p):
+            pkg.add_tree(p)
+        else:
+            pkg.add_file(p)
+    pkg.mark_traced()
+    allowlist = allowlist or {}
+
+    raw: List[Finding] = []
+    for mi in pkg.modules.values():
+        funcs = {id(fi.node): fi for fi in mi.funcs.values()}
+        for fi in set(funcs.values()):
+            _Checker(pkg, mi, fi, raw).visit(fi.node)
+            if fi.traced:
+                _np_float64_calls(fi, mi, raw)
+        # module top level (rare, but .item() at import time counts)
+        top = _Checker(pkg, mi, None, raw)
+        for stmt in mi.tree.body:
+            if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                top.visit(stmt)
+
+    # dedupe (nested defs can be visited from two scopes)
+    seen: Set[Tuple[str, int, str, str]] = set()
+    findings: List[Finding] = []
+    for f in sorted(raw, key=lambda f: (f.path, f.line, f.rule)):
+        key = (f.path, f.line, f.rule, f.message)
+        if key in seen:
+            continue
+        seen.add(key)
+        mi = next(m for m in pkg.modules.values() if m.path == f.path)
+        sup = _suppressions_for(mi.lines, f.line)
+        if sup is not None and f.rule in sup[0]:
+            if not sup[1]:
+                findings.append(Finding(
+                    f.path, f.line, "suppression",
+                    f"graftlint: allow({f.rule}) has no reason; "
+                    "suppressions must say why (\"# graftlint: "
+                    "allow(rule) — reason\")", f.qualname))
+            continue
+        wl = allowlist.get((f.path, f.rule, f.qualname))
+        if wl is not None:
+            if wl:
+                continue
+            findings.append(Finding(
+                f.path, f.line, "suppression",
+                "allowlist entry has no reason", f.qualname))
+            continue
+        findings.append(f)
+    return findings

@@ -1,0 +1,251 @@
+"""Runtime retrace/transfer sanitizer for the training and serving hot
+paths.
+
+graftlint (lint.py) catches the hazards the AST can see; this module
+catches the ones only the runtime can: a jitted builder silently
+retracing across boosting iterations (each retrace is seconds of XLA
+compile on the TPU queue), and implicit host↔device transfers sneaking
+into the pipelined loop (each one a dispatch stall — the dominant
+scaling tax of accelerator tree boosting, arXiv:1706.08359 §5).
+
+Two mechanisms, wrapped in one context manager:
+
+- ``jax.transfer_guard(guard)`` around the loop: with the default
+  ``"disallow"``, any IMPLICIT transfer raises at the violating dispatch
+  while the explicit APIs (``jax.device_put`` / ``jax.device_get``) the
+  fixed hot path uses stay legal.  Violations caught at ``step()``
+  granularity increment ``sanitize/implicit_transfers``.
+- compilation-event capture via ``jax_log_compiles``: a logging handler
+  on the ``jax`` logger counts "Compiling <name>" records per step;
+  compiles after the declared warmup increment ``sanitize/retraces``.
+
+Counters land in the always-on profiling registry
+(``sanitize/retraces``, ``sanitize/implicit_transfers``,
+``sanitize/compiles_total``), so bench.py records them in its JSON line
+and the /stats endpoint can expose them.  ``BENCH_SANITIZE=1`` modes in
+bench.py / scripts/bench_serve.py / scripts/profile_hotpath.py and the
+MULTICHIP dryrun gate assert both are zero after warmup.
+
+Backend caveat: the guard is enforced by the backend's dispatch layer
+and is a no-op for some transfer directions on some platforms (e.g.
+device→host on the CPU backend is zero-copy and never fires).  Probe
+with ``transfer_guard_effective()``; tests that require the guard carry
+the ``sanitize`` pytest marker so they can be deselected where it is
+inert.
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+from contextlib import contextmanager
+from typing import Iterator, Optional
+
+from .. import profiling
+
+RETRACES = "sanitize/retraces"
+IMPLICIT_TRANSFERS = "sanitize/implicit_transfers"
+COMPILES_TOTAL = "sanitize/compiles_total"
+
+# Retrace signal: "Finished tracing + transforming <name> for pjit" fires
+# on every (re)trace, INCLUDING compiles served from the persistent
+# compilation cache (which skip the "Compiling <name>" backend message
+# entirely — counting only that one under-reports retraces whenever
+# .jax_cache is warm).  A steady-state iteration emits neither.
+_TRACE_MARKER = "Finished tracing + transforming "
+_COMPILE_MARKER = "Compiling "
+
+
+def sanitize_enabled(env: str = "BENCH_SANITIZE") -> bool:
+    """One truthiness rule for the BENCH_SANITIZE gates (bench.py,
+    scripts/bench_serve.py, scripts/profile_hotpath.py) so the three
+    chip-queue entry points cannot diverge.  bench.py re-states the rule
+    inline at module level because importing this package there would
+    initialize jax before its backend-liveness probe."""
+    import os
+    return os.environ.get(env, "0") not in ("0", "", "false")
+
+
+def _is_transfer_guard_error(e: BaseException) -> bool:
+    msg = str(e)
+    return "Disallowed" in msg and "transfer" in msg
+
+
+def transfer_guard_effective() -> bool:
+    """True when jax.transfer_guard("disallow") actually raises on an
+    implicit host→device transfer on this backend (probe with an eager
+    op whose scalar operand must be uploaded)."""
+    import jax
+    import jax.numpy as jnp
+    if not hasattr(jax, "transfer_guard"):
+        return False
+    x = jnp.zeros(2)            # committed before the guard
+    try:
+        with jax.transfer_guard("disallow"):
+            (x * 2.0).block_until_ready()
+    except Exception as e:      # noqa: BLE001 — backend-specific error type
+        return _is_transfer_guard_error(e)
+    return False
+
+
+class _CompileCounter(logging.Handler):
+    """Counts trace events (the retrace signal — see _TRACE_MARKER) and
+    backend compiles separately from the jax_log_compiles record
+    stream.  One user-level retrace emits one-or-more trace records
+    (inner pjits trace too); the contract asserted is ZERO, so the
+    event count being an upper bound is fine and the captured names
+    point at the offending program."""
+
+    def __init__(self) -> None:
+        super().__init__(level=logging.DEBUG)
+        self.count = 0               # trace events (retrace signal)
+        self.compiles = 0            # backend "Compiling" events
+        self.names = []
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            msg = record.getMessage()
+        except Exception:       # noqa: BLE001 — never break the hot path
+            return
+        if msg.startswith(_TRACE_MARKER):
+            self.count += 1
+            if len(self.names) < 64:     # bounded evidence for reports
+                self.names.append(
+                    msg[len(_TRACE_MARKER):].split(" for ")[0])
+        elif msg.startswith(_COMPILE_MARKER):
+            self.compiles += 1
+
+
+class HotPathSanitizer:
+    """Context manager asserting the zero-retrace / zero-implicit-
+    transfer contract of a steady-state loop.
+
+    Usage::
+
+        with HotPathSanitizer(warmup=1) as san:
+            for _ in range(iters):
+                with san.step():
+                    bst.update()
+        assert san.retraces == 0 and san.implicit_transfers == 0
+
+    ``warmup`` steps may compile freely (first call after a cold cache);
+    compiles in any later step count as retraces.  A transfer-guard
+    violation inside ``step()`` increments the counter and, with
+    ``strict=False`` (default), is swallowed so one run can report the
+    total instead of dying at the first violation — note the violating
+    iteration's work is aborted mid-dispatch, so non-strict mode is for
+    *measuring* breakage, not for training through it.
+    """
+
+    def __init__(self, warmup: int = 1, guard: str = "disallow",
+                 strict: bool = False, label: str = "hot_path",
+                 d2d_guard: str = "allow"):
+        self.warmup = int(warmup)
+        self.guard = guard
+        # device→device resharding (e.g. the replicated gradient
+        # scattering into a shard_map mesh) is legitimate SPMD traffic,
+        # not the host-sync stall class this sanitizer hunts — allowed
+        # by default, tightten via d2d_guard="disallow" to audit it too
+        self.d2d_guard = d2d_guard
+        self.strict = strict
+        self.label = label
+        self.steps = 0
+        self.retraces = 0
+        self.implicit_transfers = 0
+        self.compiles_total = 0
+        self.trace_events = 0
+        self.compile_names = []
+        self._handler: Optional[_CompileCounter] = None
+        self._prev_log_compiles = None
+        self._prev_propagate = None
+
+    # -- lifecycle ------------------------------------------------------
+    def __enter__(self) -> "HotPathSanitizer":
+        import jax
+        self._handler = _CompileCounter()
+        lg = logging.getLogger("jax")
+        lg.addHandler(self._handler)
+        # capture without spraying WARNING-level compile logs to stderr
+        self._prev_propagate = lg.propagate
+        lg.propagate = False
+        self._prev_log_compiles = jax.config.jax_log_compiles
+        jax.config.update("jax_log_compiles", True)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        import jax
+        jax.config.update("jax_log_compiles", self._prev_log_compiles)
+        lg = logging.getLogger("jax")
+        lg.removeHandler(self._handler)
+        lg.propagate = self._prev_propagate
+        self.trace_events = self._handler.count
+        self.compiles_total = self._handler.compiles
+        self.compile_names = list(self._handler.names)
+        profiling.count(RETRACES, self.retraces)
+        profiling.count(IMPLICIT_TRANSFERS, self.implicit_transfers)
+        profiling.count(COMPILES_TOTAL, self.compiles_total)
+        return False
+
+    # -- per-iteration accounting --------------------------------------
+    @contextmanager
+    def step(self) -> Iterator[None]:
+        """One hot-loop iteration.  Warmup steps run UNGUARDED (a cold
+        cache may legitimately compile, and compiling transfers
+        constants); post-warmup steps run under the transfer guard and
+        attribute compile events to retraces."""
+        import jax
+        before = self._handler.count
+        guarded = (self.steps >= self.warmup
+                   and hasattr(jax, "transfer_guard"))
+        try:
+            with contextlib.ExitStack() as stack:
+                if guarded:
+                    if hasattr(jax, "transfer_guard_host_to_device"):
+                        stack.enter_context(
+                            jax.transfer_guard_host_to_device(self.guard))
+                        stack.enter_context(
+                            jax.transfer_guard_device_to_host(self.guard))
+                        stack.enter_context(
+                            jax.transfer_guard_device_to_device(
+                                self.d2d_guard))
+                    else:       # older jax: one knob for all directions
+                        stack.enter_context(jax.transfer_guard(self.guard))
+                yield
+        except Exception as e:   # noqa: BLE001 — classify, then re-raise
+            if guarded and _is_transfer_guard_error(e):
+                self.implicit_transfers += 1
+                if self.strict:
+                    raise
+            else:
+                raise
+        finally:
+            self.steps += 1
+            new = self._handler.count - before
+            if self.steps > self.warmup and new:
+                self.retraces += new
+
+    # -- reporting ------------------------------------------------------
+    def report(self) -> dict:
+        """JSON-ready summary (bench.py embeds this under "sanitize")."""
+        return {
+            "label": self.label,
+            "guard": self.guard,
+            "steps": self.steps,
+            "warmup": self.warmup,
+            "retraces_after_warmup": self.retraces,
+            "implicit_transfers": self.implicit_transfers,
+            "trace_events_total": self.trace_events,
+            "compiles_total": self.compiles_total,
+            # first offending program names — the evidence a regression
+            # report needs to find the retracing call site
+            "retrace_names": self.compile_names[-8:] if self.retraces else [],
+        }
+
+    def check(self) -> None:
+        """Raise with a diagnostic when the zero/zero contract is broken."""
+        if self.retraces or self.implicit_transfers:
+            raise AssertionError(
+                f"hot-path sanitizer [{self.label}]: "
+                f"{self.retraces} retrace(s) and "
+                f"{self.implicit_transfers} implicit transfer(s) after "
+                f"{self.warmup} warmup step(s) over {self.steps} steps; "
+                f"recent compiles: {self.compile_names[-8:]}")

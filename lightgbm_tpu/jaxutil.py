@@ -1,0 +1,49 @@
+"""Shared jitted micro-helpers that keep the hot path transfer-guard
+clean.
+
+Eager slicing/indexing/padding with Python scalars lowers to
+dynamic_slice / scatter / pad whose start-index or fill operand is
+uploaded host→device on EVERY call — one implicit transfer per boosting
+iteration per site, flagged by the sanitizer
+(diagnostics/sanitize.py) and measured as a dispatch stall on remote
+TPUs.  Jitting with static bounds turns those scalars into trace
+constants.  One home for the pattern, so the learners, the score
+updater, and the metrics cannot drift apart (the same reason
+learner/common.py exists for the split-search setup).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("pad",))
+def pad_rows_dev(x: jax.Array, *, pad: int) -> jax.Array:
+    """Zero-pad the trailing row axis on device (the eager jnp.pad
+    uploads its fill scalar per call)."""
+    return jnp.pad(x, (0, pad))
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def slice_rows_dev(x: jax.Array, *, n: int) -> jax.Array:
+    """x[:n] with a trace-constant bound (the eager slice lowers to
+    dynamic_slice and uploads its start index per call)."""
+    return x[:n]
+
+
+@jax.jit
+def bag_mask_dev(bag_idx: jax.Array, base_mask: jax.Array) -> jax.Array:
+    """Bag membership mask on device (sentinel indices drop): jitted so
+    the 1.0 fill is a trace constant, not a per-redraw scalar upload."""
+    return (jnp.zeros_like(base_mask).at[bag_idx].set(1.0, mode="drop")
+            * base_mask)
+
+
+@functools.lru_cache(maxsize=None)
+def unstack_scalars(n: int):
+    """Jitted [n] vector → n lazy 0-d device scalars in ONE program
+    (eager v[i] uploads a dynamic_slice start index per element).
+    Returns the compiled callable; cached per n."""
+    return jax.jit(lambda v: tuple(v[i] for i in range(n)))

@@ -38,6 +38,7 @@ from ..config import Config
 from ..dataset import Dataset
 from .common import (make_split_kw, padded_bin_count, resolve_hist_exchange,
                      sentinel_bins_t, use_parent_hist_cache)
+from ..jaxutil import bag_mask_dev, pad_rows_dev, slice_rows_dev
 from ..ops.histogram import histogram_full_masked
 from ..ops.split import (best_split, bundle_predicate_params,
                          combine_sharded_records, identity_feat_table,
@@ -459,7 +460,10 @@ def tree_arrays_to_host(arrs, dataset: Dataset, max_leaves: int) -> Tree:
     thresholds via the BinMappers) from device TreeArrays.  Accepts either
     a TreeArrays of device arrays or an already-unpacked numpy TreeArrays."""
     if isinstance(arrs.num_leaves, jax.Array):
-        a = unpack_tree_arrays(np.asarray(pack_tree_arrays(arrs)),
+        # pack to ONE vector, then ONE explicit fetch (jax.device_get):
+        # per-array fetches cost a round-trip each, and np.asarray here
+        # would be an implicit transfer under the sanitizer's guard
+        a = unpack_tree_arrays(jax.device_get(pack_tree_arrays(arrs)),
                                max_leaves)
     else:
         a = arrs
@@ -652,14 +656,23 @@ class FusedTreeLearner:
 
     def _feature_mask(self):
         frac = self.config.feature_fraction
+        if frac >= 1.0:
+            # no sampling: cached device copy — re-uploading the constant
+            # mask was one implicit transfer per boosting iteration
+            if self.mh is not None:
+                return self._base_fmask
+            if getattr(self, "_fmask_dev", None) is None:
+                self._fmask_dev = jax.device_put(self._base_fmask)
+            return self._fmask_dev
         m = self._base_fmask.copy()
-        if frac < 1.0:
-            k = max(1, int(round(self.F * frac)))
-            sel = self._feat_rng.choice(self.F, size=k, replace=False)
-            mm = np.zeros(self.Fp, bool)
-            mm[sel] = True
-            m &= mm
-        return m if self.mh is not None else jnp.asarray(m)
+        k = max(1, int(round(self.F * frac)))
+        sel = self._feat_rng.choice(self.F, size=k, replace=False)
+        mm = np.zeros(self.Fp, bool)
+        mm[sel] = True
+        m &= mm
+        # per-iteration host draw is the design (reference rng parity);
+        # the upload is deliberate, so it is explicit
+        return m if self.mh is not None else jax.device_put(m)
 
     def _pad_rows(self, x: jax.Array):
         if self.mh is not None:
@@ -668,7 +681,7 @@ class FusedTreeLearner:
                 self.mh.pad_local(np.asarray(x, np.float32)), P("data"))
         if self.Np == self.N:
             return x
-        return jnp.pad(x, (0, self.Np - self.N))
+        return pad_rows_dev(x, pad=self.Np - self.N)
 
     def _record_comm_stats(self) -> None:
         """Per-tree comms accounting for the data-parallel exchange.
@@ -709,13 +722,14 @@ class FusedTreeLearner:
             from jax.sharding import PartitionSpec as P
             mask = self.mh.put_rows(mask, P("data"))
         else:
-            mask = jnp.asarray(self._row_mask)
+            if getattr(self, "_row_mask_dev", None) is None:
+                self._row_mask_dev = jax.device_put(self._row_mask)
+            mask = self._row_mask_dev
             if bag_idx is not None:
                 # bag_idx is padded with sentinel N, which IS in bounds
                 # when rows are padded (Np > N) — multiply by the base
                 # row mask so padding rows can never count
-                mask = jnp.zeros(self.Np, jnp.float32).at[bag_idx].set(
-                    1.0, mode="drop") * mask
+                mask = bag_mask_dev(bag_idx, mask)
         arrs, leaf_id = self._build(
             self.bins_dev, self._pad_rows(grad), self._pad_rows(hess), mask,
             self.num_bins_dev, self.is_cat_dev, self._feature_mask())
@@ -724,7 +738,7 @@ class FusedTreeLearner:
                                    self.config.num_leaves)
         if self.mh is not None:
             return tree, jnp.asarray(self.mh.local_rows(leaf_id))
-        return tree, leaf_id[: self.N]
+        return tree, slice_rows_dev(leaf_id, n=self.N)
 
 
 def make_mesh(tree_learner: str, num_machines: int = 0

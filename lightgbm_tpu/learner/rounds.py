@@ -50,6 +50,8 @@ from .common import (gather_capacity_tiers, gather_scratch_capacity,
                      resolve_hist_rows, sentinel_bins_t,
                      use_parent_hist_cache)
 from .fused import TreeArrays, tree_arrays_to_host
+from ..jaxutil import bag_mask_dev, pad_rows_dev, slice_rows_dev, \
+    unstack_scalars
 from ..ops.histogram import hist_multileaf_gathered, hist_multileaf_masked
 from ..ops.partition import partition_rows
 from ..ops.split import (best_split, bundle_predicate_params,
@@ -58,6 +60,7 @@ from ..ops.split import (best_split, bundle_predicate_params,
 from ..tree import Tree
 
 NEG_INF = -jnp.inf
+
 # Leaves histogrammed per multi-leaf pass.  3·K is the M dimension of the
 # hist matmul, and a LARGER K means FEWER full-row passes per round.  The
 # ISOLATED kernel's per-pass cost is nearly flat in K on the int8 path
@@ -849,7 +852,8 @@ class RoundsTreeLearner:
             mm = np.zeros(len(self._base_fmask), bool)
             mm[sel] = True
             m &= mm
-        return m if self.mh is not None else jnp.asarray(m)
+        # per-iteration host draw is the design; the upload is explicit
+        return m if self.mh is not None else jax.device_put(m)
 
     def _pad_rows(self, x: jax.Array):
         if self.mh is not None:
@@ -858,7 +862,7 @@ class RoundsTreeLearner:
                 self.mh.pad_local(np.asarray(x, np.float32)), P("data"))
         if self.Np == self.N:
             return x
-        return jnp.pad(x, (0, self.Np - self.N))
+        return pad_rows_dev(x, pad=self.Np - self.N)
 
     def _masks(self, bag_idx):
         if self.mh is not None:
@@ -875,16 +879,15 @@ class RoundsTreeLearner:
                      else self._base_fmask)
             return mask, fmask
         if self._row_mask_dev is None:
-            self._row_mask_dev = jnp.asarray(self._row_mask)
+            self._row_mask_dev = jax.device_put(self._row_mask)
         mask = self._row_mask_dev
         if bag_idx is not None:
-            mask = jnp.zeros(self.Np, jnp.float32).at[bag_idx].set(
-                1.0, mode="drop") * mask
+            mask = bag_mask_dev(bag_idx, mask)
         if self.config.feature_fraction < 1.0:
             fmask = self._feature_mask()
         else:
             if self._fmask_dev is None:
-                self._fmask_dev = jnp.asarray(self._base_fmask)
+                self._fmask_dev = jax.device_put(self._base_fmask)
             fmask = self._fmask_dev
         return mask, fmask
 
@@ -903,12 +906,15 @@ class RoundsTreeLearner:
         # device scalars, folded into the counters at the next metrics
         # read — no sync on the pipelined path
         self._record_stats(profiling, stats)
-        return pack_tree_arrays(arrs), leaf_id[: self.N], arrs
+        return pack_tree_arrays(arrs), slice_rows_dev(leaf_id, n=self.N), arrs
 
     def _record_stats(self, profiling, stats) -> None:
-        profiling.count_deferred(profiling.HIST_ROWS_TOUCHED, stats[0])
-        profiling.count_deferred(profiling.HIST_EXCHANGE_BYTES, stats[1])
-        profiling.count_deferred(profiling.SPLIT_RECORDS_BYTES, stats[2])
+        # one jitted unstack: eager stats[i] indexing lowers to
+        # dynamic_slice and uploads its start index per iteration
+        s0, s1, s2 = unstack_scalars(3)(stats)
+        profiling.count_deferred(profiling.HIST_ROWS_TOUCHED, s0)
+        profiling.count_deferred(profiling.HIST_EXCHANGE_BYTES, s1)
+        profiling.count_deferred(profiling.SPLIT_RECORDS_BYTES, s2)
 
     def train(self, grad: jax.Array, hess: jax.Array,
               bag_idx: Optional[jax.Array] = None,
@@ -922,4 +928,4 @@ class RoundsTreeLearner:
         tree = tree_arrays_to_host(arrs, self.dataset, self.config.num_leaves)
         if self.mh is not None:
             return tree, jnp.asarray(self.mh.local_rows(leaf_id))
-        return tree, leaf_id[: self.N]
+        return tree, slice_rows_dev(leaf_id, n=self.N)

@@ -10,9 +10,12 @@ Each metric has TWO evaluation paths:
 - `eval(score)` — host float64 over a fetched numpy score (the reference
   also evaluates in double, src/metric/*.hpp).
 - `eval_device(score)` — device kernels (ops/eval.py) over the RESIDENT
-  [K, N] score: only scalars cross the device→host boundary, so per-
-  iteration eval no longer fetches the whole score vector (the reference's
-  per-eval host pass, gbdt.cpp:520-578, is the analog it replaces).
+  [K, N] score: results come back as LAZY 0-d device scalars (no float()
+  here — that was one blocking sync per metric per iteration, the
+  implicit-transfer stall the sanitizer flags) and the boosting driver
+  fetches every metric of the iteration with ONE batched
+  jax.device_get (GBDT._materialize_evals).  The reference's per-eval
+  host pass (gbdt.cpp:520-578) is the analog this replaces.
 Metrics report `factor_to_bigger_better` (+1/-1) so early stopping can
 maximize uniformly (metric.h:32).
 """
@@ -56,32 +59,47 @@ class Metric:
     # -- device path --------------------------------------------------------
     def _dev(self):
         """Lazy device copies of label/weights (shared per metric; built
-        only when a device eval actually happens)."""
+        only when a device eval actually happens).  Explicit device_put:
+        this may run inside the sanitized loop's first eval."""
         if not hasattr(self, "_dev_cache"):
-            import jax.numpy as jnp
-            lab = jnp.asarray(self.label, jnp.float32)
+            import jax
+            lab = jax.device_put(np.asarray(self.label, np.float32))
             w = (None if self.weights is None
-                 else jnp.asarray(self.weights, jnp.float32))
+                 else jax.device_put(np.asarray(self.weights, np.float32)))
             self._dev_cache = (lab, w)
         return self._dev_cache
+
+    def _dev_scalars(self):
+        """Device-resident (sum_weights, p1, p2) f32 scalars.  Passing
+        the Python floats to the jitted kernels re-uploaded all three
+        host→device on EVERY eval call — three implicit transfers per
+        metric per iteration under the sanitizer's guard."""
+        if not hasattr(self, "_dev_scalar_cache"):
+            import jax
+            p1, p2 = self._device_params()
+            self._dev_scalar_cache = tuple(
+                jax.device_put(np.float32(v))
+                for v in (self.sum_weights, p1, p2))
+        return self._dev_scalar_cache
 
     def _device_params(self) -> Tuple[float, float]:
         return (0.0, 0.0)
 
     def eval_device(self, score, objective=None
                     ) -> Optional[List[Tuple[str, float]]]:
-        """score: DEVICE [K, N] raw scores.  Returns [(name, value)] or
-        None when this metric has no device kernel (caller falls back to
-        the host path)."""
+        """score: DEVICE [K, N] raw scores.  Returns [(name, value)]
+        where value may be a LAZY 0-d device scalar (callers batch-fetch
+        all of an iteration's metrics with one jax.device_get —
+        GBDT._materialize_evals), or None when this metric has no device
+        kernel (caller falls back to the host path)."""
         if self.device_kind is None:
             return None
         from .ops import eval as deval
         lab, w = self._dev()
-        p1, p2 = self._device_params()
-        v = deval.pointwise_loss(score.reshape(-1), lab, w,
-                                 self.sum_weights, kind=self.device_kind,
-                                 p1=p1, p2=p2)
-        return [(self.name, float(v))]
+        sw, p1, p2 = self._dev_scalars()
+        v = deval.pointwise_loss(score.reshape(-1), lab, w, sw,
+                                 kind=self.device_kind, p1=p1, p2=p2)
+        return [(self.name, v)]
 
     def _avg(self, losses: np.ndarray) -> float:
         if self.weights is None:
@@ -105,8 +123,9 @@ class RMSEMetric(L2Metric):
         return [(self.name, float(np.sqrt(super().eval(score)[0][1])))]
 
     def eval_device(self, score, objective=None):
+        import jax.numpy as jnp
         res = super().eval_device(score, objective)
-        return [(self.name, float(np.sqrt(res[0][1])))]
+        return [(self.name, jnp.sqrt(res[0][1]))]   # stays a lazy scalar
 
 
 class L1Metric(Metric):
@@ -193,7 +212,7 @@ class AUCMetric(Metric):
     def eval_device(self, score, objective=None):
         from .ops import eval as deval
         lab, w = self._dev()
-        return [(self.name, float(deval.auc(score.reshape(-1), lab, w)))]
+        return [(self.name, deval.auc(score.reshape(-1), lab, w))]
 
     def eval(self, score, objective=None):
         """Weighted, tie-aware rank-sum AUC (binary_metric.hpp:156+)."""
@@ -228,17 +247,18 @@ class MultiLoglossMetric(Metric):
 
     def _dev_label_int(self):
         if not hasattr(self, "_dev_li"):
-            import jax.numpy as jnp
-            self._dev_li = jnp.asarray(self.label.astype(np.int32))
+            import jax
+            self._dev_li = jax.device_put(self.label.astype(np.int32))
         return self._dev_li
 
     def eval_device(self, score, objective=None):
         from .ops import eval as deval
         _, w = self._dev()
+        sw, _, _ = self._dev_scalars()
         K = self.config.num_class
         v = deval.multi_logloss(score.reshape(K, -1), self._dev_label_int(),
-                                w, self.sum_weights)
-        return [(self.name, float(v))]
+                                w, sw)
+        return [(self.name, v)]
 
     def eval(self, score, objective=None):
         K = self.config.num_class
@@ -257,10 +277,11 @@ class MultiErrorMetric(MultiLoglossMetric):
     def eval_device(self, score, objective=None):
         from .ops import eval as deval
         _, w = self._dev()
+        sw, _, _ = self._dev_scalars()
         K = self.config.num_class
         v = deval.multi_error(score.reshape(K, -1), self._dev_label_int(),
-                              w, self.sum_weights)
-        return [(self.name, float(v))]
+                              w, sw)
+        return [(self.name, v)]
 
     def eval(self, score, objective=None):
         K = self.config.num_class
@@ -309,7 +330,7 @@ class NDCGMetric(Metric):
         """Device query structures shared by ndcg/map: query id per row,
         query start per row, and the DCG tables."""
         if not hasattr(self, "_dev_rank_cache"):
-            import jax.numpy as jnp
+            import jax
             qb = np.asarray(self.metadata.query_boundaries, np.int64)
             sizes = np.diff(qb)
             qid = np.repeat(np.arange(len(sizes), dtype=np.int32),
@@ -318,11 +339,11 @@ class NDCGMetric(Metric):
             label_gain, discount = _dcg_tables(self.config, self.num_data)
             qw = self._host_qw()
             self._dev_rank_cache = (
-                jnp.asarray(qid), jnp.asarray(qstart),
-                jnp.asarray(label_gain.astype(np.float32)),
-                jnp.asarray(discount.astype(np.float32)),
+                jax.device_put(qid), jax.device_put(qstart),
+                jax.device_put(label_gain.astype(np.float32)),
+                jax.device_put(discount.astype(np.float32)),
                 len(sizes),
-                None if qw is None else jnp.asarray(qw))
+                None if qw is None else jax.device_put(np.asarray(qw)))
         return self._dev_rank_cache
 
     def eval_device(self, score, objective=None):
@@ -331,13 +352,16 @@ class NDCGMetric(Metric):
         from .ops import eval as deval
         qid, qstart, gain_t, disc_t, Q, qw = self._dev_rank()
         if not hasattr(self, "_dev_li"):
-            import jax.numpy as jnp
-            self._dev_li = jnp.asarray(self.label.astype(np.int32))
+            import jax
+            self._dev_li = jax.device_put(self.label.astype(np.int32))
         ks = tuple(int(k) for k in self.config.ndcg_eval_at)
         vals = deval.ndcg_at_k(score.reshape(-1), self._dev_li, qid, qstart,
                                gain_t, disc_t, qw, ks=ks, num_queries=Q)
-        vals = np.asarray(vals)
-        return [(f"ndcg@{k}", float(vals[i])) for i, k in enumerate(ks)]
+        # one jitted unstack into lazy device scalars; the driver's
+        # batched device_get fetches every k at once
+        from .jaxutil import unstack_scalars
+        parts = unstack_scalars(len(ks))(vals)
+        return [(f"ndcg@{k}", parts[i]) for i, k in enumerate(ks)]
 
     def eval(self, score, objective=None):
         """Vectorized host NDCG: ONE lexicographic sort of all rows keyed
@@ -389,15 +413,16 @@ class MAPMetric(NDCGMetric):
         if self.metadata.query_boundaries is None:
             return None
         from .ops import eval as deval
-        import jax.numpy as jnp
+        import jax
         qid, qstart, _, _, Q, qw = self._dev_rank()
         if not hasattr(self, "_dev_lpos"):
-            self._dev_lpos = jnp.asarray((self.label > 0))
+            self._dev_lpos = jax.device_put(self.label > 0)
         ks = tuple(int(k) for k in self.config.ndcg_eval_at)
         vals = deval.map_at_k(score.reshape(-1), self._dev_lpos, qid, qstart,
                               qw, ks=ks, num_queries=Q)
-        vals = np.asarray(vals)
-        return [(f"map@{k}", float(vals[i])) for i, k in enumerate(ks)]
+        from .jaxutil import unstack_scalars
+        parts = unstack_scalars(len(ks))(vals)
+        return [(f"map@{k}", parts[i]) for i, k in enumerate(ks)]
 
     def eval(self, score, objective=None):
         """Vectorized host MAP (mirrors ops/eval.map_at_k; see NDCGMetric

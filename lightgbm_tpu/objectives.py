@@ -95,9 +95,9 @@ class RegressionL2(Objective):
         return self._f(score, self.label, self.weights)
 
     def initial_score(self) -> float:
-        lab = np.asarray(self.label, np.float64)
+        lab = jax.device_get(self.label).astype(np.float64)
         if self.weights is not None:
-            w = np.asarray(self.weights, np.float64)
+            w = jax.device_get(self.weights).astype(np.float64)
             return float((lab * w).sum() / w.sum())
         return float(lab.mean())
 
@@ -124,7 +124,7 @@ class RegressionL1(Objective):
         return self._f(score, self.label, self.weights)
 
     def initial_score(self) -> float:
-        return float(np.median(np.asarray(self.label, np.float64)))
+        return float(np.median(jax.device_get(self.label).astype(np.float64)))
 
 
 def _gaussian_hessian(y, t, g, eta, w):
@@ -164,7 +164,7 @@ class RegressionHuber(Objective):
         return self._f(score, self.label, self.weights)
 
     def initial_score(self) -> float:
-        return float(np.mean(np.asarray(self.label, np.float64)))
+        return float(np.mean(jax.device_get(self.label).astype(np.float64)))
 
 
 class RegressionFair(Objective):
@@ -188,7 +188,7 @@ class RegressionFair(Objective):
         return self._f(score, self.label, self.weights)
 
     def initial_score(self) -> float:
-        return float(np.mean(np.asarray(self.label, np.float64)))
+        return float(np.mean(jax.device_get(self.label).astype(np.float64)))
 
 
 class RegressionPoisson(Objective):
@@ -213,7 +213,7 @@ class RegressionPoisson(Objective):
         return self._f(score, self.label, self.weights)
 
     def initial_score(self) -> float:
-        return float(np.mean(np.asarray(self.label, np.float64)))
+        return float(np.mean(jax.device_get(self.label).astype(np.float64)))
 
 
 class BinaryLogloss(Objective):

@@ -166,6 +166,7 @@ def _coerce_dtype(input_dtype: str) -> str:
     so (the warning fires once per compile, at trace time)."""
     if input_dtype == "int8":
         from .. import log
+        # graftlint: allow(retrace-hazard) — deliberate ONE-shot warning at trace time (static branch, never re-fires per iteration)
         log.warning("histogram_dtype=int8 is only supported by the "
                     "batched-rounds learner; using float32 here")
         return "float32"
@@ -642,6 +643,7 @@ def hist_multileaf_masked(gb_t: jax.Array, lid: jax.Array, gh8: jax.Array,
     # stays exact).  Shapes are static, so this resolves at trace time.
     if quant and C > 16_000_000:
         from .. import log
+        # graftlint: allow(retrace-hazard) — deliberate ONE-shot warning at trace time (shape is static, fires once per compile)
         log.warning("histogram_dtype=int8 disabled for this pass: "
                     f"{C} rows exceeds the int32-exactness bound "
                     "(16M rows per device); using bfloat16")

@@ -97,10 +97,17 @@ def count_deferred(name: str, value) -> None:
 
 def _drain_deferred_locked() -> None:
     """Fold pending device totals into _counters; caller holds _lock.
-    float() on a jax array blocks until the value is ready."""
-    for name, val in list(_deferred.items()):
+    ONE batched explicit fetch for every pending counter (jax.device_get
+    blocks until the values are ready; per-name float() was one sync per
+    counter, and implicit under the sanitizer's transfer guard)."""
+    if not _deferred:
+        return
+    import jax
+    names = list(_deferred)
+    vals = jax.device_get([_deferred[n] for n in names])
+    for name, val in zip(names, vals):
         _counters[name] += float(val)
-        del _deferred[name]
+    _deferred.clear()
 
 
 def counter_value(name: str) -> float:

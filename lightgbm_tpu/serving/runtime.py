@@ -193,10 +193,15 @@ class PredictorRuntime:
     # -- prediction -----------------------------------------------------
 
     def _run_compiled(self, bucket: int, kind: str, Xpad: np.ndarray):
-        import jax.numpy as jnp
+        import jax
         exe = self._get_executable(bucket, kind)
-        out = exe(self._stacks, jnp.asarray(Xpad, jnp.float32))
-        return np.asarray(out, np.float64)               # [K, bucket]
+        # explicit device_put/device_get keeps the serving loop clean
+        # under the sanitizer's transfer guard (BENCH_SANITIZE in
+        # scripts/bench_serve.py): implicit conversions here would be
+        # one h2d + one d2h violation per request
+        out = exe(self._stacks,
+                  jax.device_put(Xpad.astype(np.float32, copy=False)))
+        return jax.device_get(out).astype(np.float64)    # [K, bucket]
 
     def _predict_chunk(self, X: np.ndarray, kind: str) -> np.ndarray:
         n = X.shape[0]

@@ -152,19 +152,33 @@ class Tree:
         Child pointers: internal >= 0, leaves encoded as ~leaf (negative).
         """
         if self._device_cache is None:
-            import jax.numpy as jnp
-            n = max(self.num_leaves - 1, 1)
+            import jax
+            # CAPACITY shapes, not grown size: slicing to num_leaves-1
+            # keyed the downstream jit (predict_binned_leaf) on every
+            # distinct tree size — one silent retrace per new shape in
+            # the boosting loop.  Padding slots are unreachable from the
+            # root walk, so their (zero) contents never matter.
+            n = max(self.max_leaves - 1, 1)
             binned_dec = getattr(self, "binned_decision_type",
                                  self.decision_type)
-            self._device_cache = dict(
-                split_feature_inner=jnp.asarray(self.split_feature_inner[:n]),
-                threshold_in_bin=jnp.asarray(self.threshold_in_bin[:n].astype(np.int32)),
-                decision_type=jnp.asarray(binned_dec[:n].astype(np.int32)),
-                left_child=jnp.asarray(self.left_child[:n]),
-                right_child=jnp.asarray(self.right_child[:n]),
-                leaf_value=jnp.asarray(self.leaf_value[: max(self.num_leaves, 1)].astype(np.float32)),
-                depth=self.max_depth_grown,
+            # ONE explicit pytree upload (jax.device_put): per-array
+            # jnp.asarray was six implicit transfers per new tree inside
+            # the boosting loop (sanitizer transfer-guard violations)
+            host = dict(
+                split_feature_inner=self.split_feature_inner[:n],
+                threshold_in_bin=self.threshold_in_bin[:n].astype(np.int32),
+                decision_type=binned_dec[:n].astype(np.int32),
+                left_child=self.left_child[:n],
+                right_child=self.right_child[:n],
+                leaf_value=self.leaf_value[: max(self.max_leaves, 1)
+                                           ].astype(np.float32),
             )
+            # depth rounds up to a power of two: it is a static jit arg,
+            # and the raw grown depth would retrace per new value; extra
+            # walk levels are no-ops (rows parked at leaves stay parked)
+            depth = max(self.max_depth_grown, 1)
+            depth = 1 << (depth - 1).bit_length()
+            self._device_cache = dict(jax.device_put(host), depth=depth)
         return self._device_cache
 
     # -- serialization (reference tree.cpp:295-330) -------------------------

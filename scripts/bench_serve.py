@@ -10,6 +10,12 @@ against, not just train seconds/iter.
 Env knobs: SERVE_BENCH_ROWS (rows per request, default 64),
 SERVE_BENCH_CLIENTS (default 8), SERVE_BENCH_REQUESTS (total, default
 400), SERVE_BENCH_TREES (default 50).
+
+BENCH_SANITIZE=1 additionally probes the PredictorRuntime hot path
+directly (single-threaded — jax's transfer guard is thread-local, so
+the HTTP stack's flush thread can't be guarded from here) and asserts
+ZERO retraces and ZERO implicit transfers per request after warmup;
+counters ride in the JSON line under "sanitize".
 """
 import json
 import os
@@ -59,6 +65,21 @@ def main() -> None:
         registry = ModelRegistry(model_path, params={"verbose": -1},
                                  max_batch_rows=4096,
                                  warmup_buckets=tuple(warm) or (ROWS_PER_REQ,))
+        san = None
+        san_rec = None
+        from lightgbm_tpu.diagnostics.sanitize import (
+            HotPathSanitizer, sanitize_enabled)
+        if sanitize_enabled():
+            runtime = registry.current()
+            Xq = np.ascontiguousarray(X[:ROWS_PER_REQ], np.float64)
+            san = HotPathSanitizer(warmup=1, label="serve")
+            with san:
+                for _ in range(8):
+                    with san.step():
+                        runtime.predict(Xq)
+            san_rec = san.report()
+            # violations fail AFTER the JSON line below is printed, so
+            # the chip-queue log always has the counter evidence
         server = PredictionServer(registry, flush_deadline_ms=2.0,
                                   model_poll_seconds=0)
         latencies = []
@@ -109,14 +130,19 @@ def main() -> None:
 
     lat = sorted(latencies)
     if errors or not lat:
-        print(json.dumps({"metric": "serve latency", "value": None,
-                          "unit": "ms", "error": str(errors[:3])}))
+        out = {"metric": "serve latency", "value": None,
+               "unit": "ms", "error": str(errors[:3])}
+        if san_rec is not None:
+            out["sanitize"] = san_rec
+        print(json.dumps(out))
+        if san is not None:
+            san.check()
         return
 
     def q(p: float) -> float:
         return lat[min(len(lat) - 1, int(p * len(lat)))]
 
-    print(json.dumps({
+    out = {
         "metric": f"serve synthetic {FEATURES}f {TREES} trees, "
                   f"{ROWS_PER_REQ} rows/req x {CLIENTS} clients: "
                   f"p50 request latency",
@@ -128,7 +154,12 @@ def main() -> None:
         "warm_cache_misses": misses_after - misses_before,
         "batches": stats["batches"],
         "generation": stats["generation"],
-    }))
+    }
+    if san_rec is not None:
+        out["sanitize"] = san_rec
+    print(json.dumps(out))
+    if san is not None:
+        san.check()     # fail AFTER the JSON so counters are recorded
 
 
 if __name__ == "__main__":

@@ -227,12 +227,31 @@ def main():
     for _ in range(3):
         bst.update()
     _force(bst._gbdt.train_score.score)
+    # BENCH_SANITIZE=1: run the timed window under the hot-path
+    # sanitizer — the zero-retrace / zero-implicit-transfer contract is
+    # asserted on the same loop the MFU profile times, and the result
+    # rides along in the committed artifact
+    from lightgbm_tpu.diagnostics.sanitize import (HotPathSanitizer,
+                                                   sanitize_enabled)
+    san = None
     t0 = time.perf_counter()
-    for _ in range(10):
-        bst.update()
+    if sanitize_enabled():
+        san = HotPathSanitizer(warmup=1, label="profile_hotpath")
+        with san:
+            for _ in range(10):
+                with san.step():
+                    bst.update()
+    else:
+        for _ in range(10):
+            bst.update()
     _force(bst._gbdt.train_score.score)
     full = (time.perf_counter() - t0) / 10
     rec["full_update_ms"] = round(full * 1e3, 1)
+    if san is not None:
+        rec["sanitize"] = san.report()
+        print(f"sanitize: {san.retraces} retraces, "
+              f"{san.implicit_transfers} implicit transfers "
+              f"(over {san.steps} steps, warmup 1)")
     print(f"full update(): {full*1e3:.1f} ms/iter")
 
     # non-default shapes get their own artifact: the north-star MFU
@@ -244,6 +263,8 @@ def main():
     with open(os.path.join(ROOT, name), "w") as f:
         json.dump(rec, f, indent=1)
     print(f"wrote {name}")
+    if san is not None:
+        san.check()     # fail AFTER the artifact is written
 
 
 if __name__ == "__main__":

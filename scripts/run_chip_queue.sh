@@ -14,6 +14,12 @@ MARK=.bench/chip_queue_done
 mkdir -p .bench
 touch "$MARK"
 
+# Preflight: the chip window must never burn minutes on a hot path the
+# static analysis already knows is broken (graftlint — retrace/transfer
+# hazards fail HERE, on the host, before the TPU queue).  Milliseconds,
+# no jax import.
+python scripts/run_lint.py || { echo "!! graftlint preflight FAILED — fix findings before burning chip time"; exit 1; }
+
 stage() {  # stage <name> <cmd...>  (stdout tees to .bench/<name>.log)
   local name=$1; shift
   if grep -qx "$name" "$MARK"; then echo "== $name: done, skip"; return 0; fi
@@ -25,7 +31,12 @@ stage() {  # stage <name> <cmd...>  (stdout tees to .bench/<name>.log)
 
 # 1. the tracked metric at HEAD + the round-4 kernel A/B (VERDICT #1)
 stage bench_narrow_on  env BENCH_ITERS=12 python bench.py || exit 1
-stage profile python scripts/profile_hotpath.py || exit 1
+# hot-path sanitizer gate on chip (zero retraces / zero implicit
+# transfers per iteration after warmup, for BOTH TPU learners —
+# asserts after writing its JSON, so a violation still leaves evidence)
+stage bench_sanitize_rounds env BENCH_SANITIZE=1 BENCH_TREE_GROWTH=rounds BENCH_ITERS=8 python bench.py || exit 1
+stage bench_sanitize_fused  env BENCH_SANITIZE=1 BENCH_TREE_GROWTH=exact  BENCH_ITERS=8 python bench.py || exit 1
+stage profile env BENCH_SANITIZE=1 python scripts/profile_hotpath.py || exit 1
 stage bench_narrow_off env LGBT_NARROW_ONEHOT=0 BENCH_ITERS=12 python bench.py || exit 1
 stage bench_part_off   env LGBT_FUSED_PARTITION=0 BENCH_ITERS=12 python bench.py || exit 1
 # 2. the 63-bin variant (VERDICT #2: reference accelerator sweet spot)

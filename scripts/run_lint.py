@@ -1,0 +1,61 @@
+"""graftlint CLI — JAX-hazard static analysis over the package.
+
+Prints `path:line: rule: message [in qualname]` findings and exits
+nonzero when any survive suppressions and the reviewed allowlist
+(scripts/lint_allowlist.txt).  Run from tier-1
+(tests/test_lint_clean.py), the chip-queue preflight
+(scripts/run_chip_queue.sh), and standalone:
+
+    python scripts/run_lint.py [paths...]
+
+Stdlib-only (no jax import): the gate costs milliseconds.
+"""
+import argparse
+import importlib.util
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# load lint.py by PATH, not through the package: `import lightgbm_tpu`
+# initializes the whole framework (jax included, ~10 s); the linter
+# itself is pure stdlib and must stay a milliseconds-cheap gate
+_spec = importlib.util.spec_from_file_location(
+    "graftlint", os.path.join(ROOT, "lightgbm_tpu", "diagnostics",
+                              "lint.py"))
+_lint = importlib.util.module_from_spec(_spec)
+sys.modules["graftlint"] = _lint    # dataclasses resolves annotations here
+_spec.loader.exec_module(_lint)
+lint_paths, load_allowlist = _lint.lint_paths, _lint.load_allowlist
+
+ALLOWLIST_FILE = os.path.join(ROOT, "scripts", "lint_allowlist.txt")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("paths", nargs="*",
+                    default=[os.path.join(ROOT, "lightgbm_tpu")],
+                    help="files or directories (default: the package)")
+    ap.add_argument("--no-allowlist", action="store_true",
+                    help="ignore scripts/lint_allowlist.txt (show "
+                         "everything the rules match)")
+    args = ap.parse_args(argv)
+
+    allow = {} if args.no_allowlist else load_allowlist(ALLOWLIST_FILE)
+    findings = lint_paths([os.path.abspath(p) for p in args.paths], ROOT,
+                          allow)
+    for f in findings:
+        print(f.render())
+    if findings:
+        by_rule = {}
+        for f in findings:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+        summary = ", ".join(f"{r}: {n}" for r, n in sorted(by_rule.items()))
+        print(f"graftlint: {len(findings)} finding(s) ({summary})")
+        return 1
+    print("graftlint OK: no JAX-hazard findings")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

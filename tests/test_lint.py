@@ -1,0 +1,454 @@
+"""graftlint rule-by-rule fixtures: one true positive AND one true
+negative per rule class, plus suppression syntax and the reviewed
+allowlist (lightgbm_tpu/diagnostics/lint.py).
+
+These are SOURCE fixtures — the linter is pure AST, so nothing here is
+executed (no jax import cost in this module's tests)."""
+import os
+import textwrap
+
+import pytest
+
+from lightgbm_tpu.diagnostics.lint import lint_paths, load_allowlist
+
+pytestmark = pytest.mark.quick
+
+
+def run_lint(tmp_path, src, allowlist=None):
+    p = tmp_path / "fixture_mod.py"
+    p.write_text(textwrap.dedent(src))
+    return lint_paths([str(p)], str(tmp_path), allowlist or {})
+
+
+def rules_of(findings):
+    return {(f.rule, f.line) for f in findings}
+
+
+def has(findings, rule, needle):
+    return any(f.rule == rule and needle in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# host-sync
+# ---------------------------------------------------------------------------
+
+
+def test_host_sync_true_positives(tmp_path):
+    fs = run_lint(tmp_path, """
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def hot(x, y):
+            v = jnp.sum(x)
+            if v > 0:                       # tracer __bool__
+                y = y + 1
+            s = float(v)                    # float() on device value
+            a = np.asarray(v)               # implicit transfer
+            b = v.item()                    # .item()
+            return y
+        """)
+    assert has(fs, "host-sync", "__bool__")
+    assert has(fs, "host-sync", "float()")
+    assert has(fs, "host-sync", "np.asarray")
+    assert has(fs, "host-sync", ".item()")
+
+
+def test_host_sync_item_flagged_outside_traced_code_too(tmp_path):
+    fs = run_lint(tmp_path, """
+        def plain_host(arr):
+            return arr.item()
+        """)
+    assert has(fs, "host-sync", ".item()")
+
+
+def test_host_sync_true_negatives(tmp_path):
+    fs = run_lint(tmp_path, """
+        import numpy as np
+        import functools
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def clean(x, y):
+            z = jnp.where(x > 0, x, y)      # branchless: fine
+            if x.shape[0] > 3:              # static shape: fine
+                z = z * 2
+            if y is not None:               # identity test: fine
+                z = z + 1
+            return z
+
+        @functools.partial(jax.jit, static_argnames=("flag",))
+        def clean_static(x, flag):
+            if flag:                        # declared static: fine
+                x = x * 2
+            return x
+
+        def host(cfg):
+            n = float(cfg.learning_rate)    # host float of config: fine
+            m = np.asarray([1, 2, 3])       # host numpy: fine
+            fetched = jax.device_get(jnp.zeros(3))
+            return float(fetched[0]), n, m  # device_get result is host
+        """)
+    assert not any(f.rule == "host-sync" for f in fs), [f.render() for f in fs]
+
+
+def test_host_sync_reaches_through_call_graph(tmp_path):
+    """A helper only REACHABLE from jit (not itself decorated) is still
+    checked — the `if tracer:` hides one call away."""
+    fs = run_lint(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        def _helper(v):
+            y = jnp.abs(v)
+            if y > 0:                       # tracer bool, one hop from jit
+                return v
+            return -v
+
+        @jax.jit
+        def root(x):
+            return _helper(x)
+        """)
+    assert has(fs, "host-sync", "__bool__")
+
+
+def test_host_sync_lax_loop_body_params_are_tracers(tmp_path):
+    fs = run_lint(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        def _body(i, c):
+            s = jnp.sum(c)
+            if s > 0:                       # body runs traced
+                return c
+            return c * 2
+
+        def run(c):
+            return jax.lax.fori_loop(0, 3, _body, c)
+        """)
+    assert has(fs, "host-sync", "__bool__")
+
+
+def test_host_sync_tracks_device_attributes(tmp_path):
+    """Object state: self.x assigned from a device expression is a
+    device value wherever read in the module; an attr some class also
+    assigns HOST values is ambiguous and must not taint other classes;
+    multi-hop reads (self.inner.score) consult the package registry."""
+    fs = run_lint(tmp_path, """
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+
+        class Holder:
+            def __init__(self, x):
+                self.resident = jnp.asarray(x)
+            def bad(self):
+                return float(self.resident)          # device attr read
+            def good(self):
+                return float(jax.device_get(self.resident))
+
+        class Driver:
+            def __init__(self, h):
+                self.holder = h
+            def bad(self):
+                return np.asarray(self.holder.resident)   # multi-hop
+
+        class HostSide:
+            def __init__(self, y):
+                self.resident2 = np.asarray(y)        # host attr
+            def fine(self):
+                return float(self.resident2)
+        """)
+    msgs = [(f.qualname, f.rule) for f in fs]
+    assert ("Holder.bad", "host-sync") in msgs
+    assert ("Driver.bad", "host-sync") in msgs
+    assert not any(q == "Holder.good" for q, _ in msgs)
+    assert not any(q == "HostSide.fine" for q, _ in msgs)
+
+
+def test_host_sync_ambiguous_attr_not_package_tainted(tmp_path):
+    fs = run_lint(tmp_path, """
+        import numpy as np
+        import jax.numpy as jnp
+
+        class Dev:
+            def __init__(self, x):
+                self.label = jnp.asarray(x)
+
+        class Meta:
+            def __init__(self, y):
+                self.label = np.asarray(y)
+
+        class Reader:
+            def __init__(self, meta):
+                self.meta = meta
+            def fine(self):
+                # 'label' is device in Dev but HOST in Meta: ambiguous
+                # across objects, so a multi-hop read must not flag
+                return np.asarray(self.meta.label)
+        """)
+    assert not any(f.qualname == "Reader.fine" for f in fs), \
+        [f.render() for f in fs]
+
+
+def test_host_sync_float_of_jitted_package_call(tmp_path):
+    """float() of a same-package jit-root result is a sync (the
+    metrics.py bug class this PR fixed)."""
+    fs = run_lint(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(x):
+            return jnp.sum(x)
+
+        def host_eval(x):
+            v = kernel(x)
+            return float(v)                 # per-metric sync
+        """)
+    assert has(fs, "host-sync", "float()")
+
+
+# ---------------------------------------------------------------------------
+# retrace-hazard
+# ---------------------------------------------------------------------------
+
+
+def test_retrace_hazard_true_positives(tmp_path):
+    fs = run_lint(tmp_path, """
+        import functools
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def noisy(x):
+            v = jnp.sum(x)
+            print("trace-time effect")      # print in traced code
+            return x, f"value={v}"          # f-string formats a tracer
+
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def jitted(x, k):
+            return x * 2
+
+        def caller(cfg, data):
+            jitted(cfg.num_leaves, k=2)     # config -> traced param
+            jitted(data, k=cfg.max_bin)     # config -> static param: fine
+        """)
+    assert has(fs, "retrace-hazard", "print()")
+    assert has(fs, "retrace-hazard", "f-string")
+    assert has(fs, "retrace-hazard", "'num_leaves'")
+    assert not has(fs, "retrace-hazard", "'max_bin'")
+
+
+def test_retrace_hazard_true_negatives(tmp_path):
+    fs = run_lint(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def quiet(x):
+            return x * 2
+
+        def host(cfg):
+            print("host logging is fine", cfg.num_leaves)
+            return f"also fine {cfg.max_bin}"
+        """)
+    assert not any(f.rule == "retrace-hazard" for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# dtype-drift
+# ---------------------------------------------------------------------------
+
+
+def test_dtype_drift_true_positives(tmp_path):
+    fs = run_lint(tmp_path, """
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def drifty(x):
+            a = x.astype(np.float64)        # astype(float64)
+            b = jnp.zeros(3, dtype=np.float64)   # dtype kwarg
+            c = np.float64(0.5) * x         # np.float64 cast
+            d = x + 1e-300                  # literal under f32 tiny
+            return a, b, c, d
+        """)
+    assert has(fs, "dtype-drift", "astype(float64)")
+    assert has(fs, "dtype-drift", "dtype=float64")
+    assert has(fs, "dtype-drift", "np.float64 cast")
+    assert has(fs, "dtype-drift", "float32 range")
+
+
+def test_dtype_drift_true_negatives(tmp_path):
+    fs = run_lint(tmp_path, """
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def pinned(x):
+            a = x.astype(jnp.float32)
+            b = jnp.zeros(3, dtype=jnp.float32)
+            c = x * 0.5                     # representable literal
+            return a, b, c
+
+        def host(y):
+            return np.asarray(y, np.float64)    # host f64 is the contract
+        """)
+    assert not any(f.rule == "dtype-drift" for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# nondeterminism
+# ---------------------------------------------------------------------------
+
+
+def test_nondeterminism_true_positives(tmp_path):
+    fs = run_lint(tmp_path, """
+        import random
+        import time
+        import numpy as np
+        import jax
+
+        @jax.jit
+        def flaky(x):
+            t = time.time()                 # trace-time clock
+            r = random.random()             # trace-time draw
+            s = np.random.rand()            # trace-time draw
+            return x + t + r + s
+        """)
+    assert has(fs, "nondeterminism", "time.time")
+    assert has(fs, "nondeterminism", "random.random")
+    assert has(fs, "nondeterminism", "np.random.rand")
+
+
+def test_nondeterminism_true_negatives(tmp_path):
+    fs = run_lint(tmp_path, """
+        import time
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def keyed(x, key):
+            return x + jax.random.normal(key, x.shape)  # threaded key: fine
+
+        def host_timing():
+            t0 = time.perf_counter()        # host timing: fine
+            rng = np.random.RandomState(0)  # host rng: fine
+            return t0, rng.rand(3)
+        """)
+    assert not any(f.rule == "nondeterminism" for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# suppressions + allowlist
+# ---------------------------------------------------------------------------
+
+
+def test_inline_suppression_with_reason_is_honored(tmp_path):
+    fs = run_lint(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def chosen(x):
+            v = jnp.sum(x)
+            s = float(v)  # graftlint: allow(host-sync) — test sync point
+            return s
+        """)
+    assert not any(f.rule == "host-sync" for f in fs)
+    assert not any(f.rule == "suppression" for f in fs)
+
+
+def test_suppression_comment_on_line_above(tmp_path):
+    fs = run_lint(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def chosen(x):
+            v = jnp.sum(x)
+            # graftlint: allow(host-sync) — reason on the line above
+            s = float(v)
+            return s
+        """)
+    assert not any(f.rule == "host-sync" for f in fs)
+
+
+def test_suppression_without_reason_fails(tmp_path):
+    fs = run_lint(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def lazy(x):
+            v = jnp.sum(x)
+            s = float(v)  # graftlint: allow(host-sync)
+            return s
+        """)
+    assert has(fs, "suppression", "no reason")
+    assert not any(f.rule == "host-sync" for f in fs)
+
+
+def test_suppression_for_wrong_rule_does_not_mask(tmp_path):
+    fs = run_lint(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def wrong(x):
+            v = jnp.sum(x)
+            s = float(v)  # graftlint: allow(dtype-drift) — wrong rule
+            return s
+        """)
+    assert any(f.rule == "host-sync" for f in fs)
+
+
+def test_allowlist_entry_suppresses(tmp_path):
+    src = """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def listed(x):
+            v = jnp.sum(x)
+            return float(v)
+        """
+    fs = run_lint(tmp_path, src)
+    assert any(f.rule == "host-sync" for f in fs)
+    allow = {("fixture_mod.py", "host-sync", "listed"): "reviewed reason"}
+    fs2 = run_lint(tmp_path, src, allowlist=allow)
+    assert not any(f.rule == "host-sync" for f in fs2)
+
+
+def test_allowlist_file_parser(tmp_path):
+    p = tmp_path / "allow.txt"
+    p.write_text(
+        "# comment\n"
+        "\n"
+        "pkg/mod.py::host-sync::Class.meth — the reviewed reason\n")
+    allow = load_allowlist(str(p))
+    assert allow == {("pkg/mod.py", "host-sync", "Class.meth"):
+                     "the reviewed reason"}
+
+
+def test_findings_carry_location_and_qualname(tmp_path):
+    fs = run_lint(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        class Engine:
+            @jax.jit
+            def step(self, x):
+                return float(jnp.sum(x))
+        """)
+    f = next(f for f in fs if f.rule == "host-sync")
+    assert f.path == "fixture_mod.py"
+    assert f.qualname == "Engine.step"
+    assert f.line > 1
+    assert "fixture_mod.py:" in f.render()
