@@ -79,8 +79,12 @@ HIST_EXCHANGE = os.environ.get("BENCH_HIST_EXCHANGE", "")
 # BENCH_SANITIZE=1 runs the timed window under the hot-path sanitizer
 # (diagnostics/sanitize.py): jax.transfer_guard("disallow") + compile
 # capture, asserting ZERO retraces and ZERO implicit device→host
-# transfers per iteration after one warmup step.  Counters land in the
-# JSON line under "sanitize".  Meaningful for the TPU learners
+# transfers per iteration after one warmup step — and, on multi-device
+# meshes, arms the learners' DivergenceSanitizer hooks, so the JSON
+# "sanitize" block also reports divergence_checks/divergences (the
+# cross-shard replication audit) and san.check() fails on any
+# divergence.  Counters land in the JSON line under "sanitize".
+# Meaningful for the TPU learners
 # (BENCH_TREE_GROWTH=rounds, or exact→fused on chip); the CPU serial
 # learner's host loop is not a sanitize target.  The truthiness rule
 # mirrors diagnostics.sanitize.sanitize_enabled — restated here because

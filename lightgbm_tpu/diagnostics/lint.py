@@ -37,6 +37,46 @@ Rules
   arbitrary value into the compiled program, and make retraces
   unreproducible.
 
+shardlint rules (SPMD collective correctness)
+---------------------------------------------
+The data-parallel learners' correctness rests on collective invariants:
+a mismatched ``axis_name`` is an unbound-axis trace error (or, worse, a
+reduction over the wrong mesh axis), a collective skipped by one shard
+is a pod-wide deadlock on real hardware, and a shard-local value
+steering replicated control flow silently grows different trees per
+device.  These rules lean on the same traced-region call graph:
+
+- ``collective-mismatch``: a collective (``psum``/``psum_scatter``/
+  ``all_gather``/``pmean``/``all_to_all``/…/``axis_index``) whose axis
+  name is not an axis of any mesh constructed in the linted tree
+  (string-literal axes at the call site, axis-parameter bindings like
+  ``data_axis="rows"`` at any call site, and ``PartitionSpec``
+  literals are all checked); and a literal-axis collective in traced
+  code NOT reachable from any ``shard_map`` body — nothing binds the
+  axis, so the trace fails (or the collective silently no-ops under a
+  vmapped alias).
+- ``divergent-collective``: a ``lax.cond``/``lax.switch`` in traced
+  SPMD code where one branch performs a collective (directly or
+  through the call graph) and another does not, unless the predicate
+  is provably replicated (derived from ``psum``-family results or
+  ``combine_sharded_records``); or any branch collective gated by a
+  provably shard-local predicate.  Shards disagreeing on the predicate
+  enter different branches and the collective deadlocks cross-host.
+- ``scatter-divisibility``: a ``psum_scatter`` call whose enclosing
+  function (or a lexically enclosing ancestor) carries no static
+  divisibility guarantee for the scattered axis — an
+  ``assert … % … == 0``, an ``if … % …: raise`` guard, pad-to-multiple
+  arithmetic (``nd * ((x + nd - 1) // nd)``), or a call to the
+  ``pad_cols_to_ndev`` helper (learner/common.py).  Without one, a
+  non-tiling axis surfaces as a raw XLA shape error at trace time.
+- ``replication-leak``: a provably shard-local value (derived from
+  ``axis_index``/``psum_scatter``/``all_to_all``/``ppermute`` without
+  an intervening replicating collective) flowing into a
+  ``lax.cond``/``lax.switch`` predicate or a ``lax.fori_loop`` bound —
+  control flow the growth loops require to be bitwise-replicated
+  across shards (PRs 3-4).  The runtime half of this contract is
+  ``diagnostics/sanitize.DivergenceSanitizer``.
+
 Traced-region discovery: jit roots are ``@jax.jit`` /
 ``functools.partial(jax.jit, static_argnames=...)`` decorators,
 ``jax.jit(f)`` / ``jax.jit(functools.partial(f, ...))`` /
@@ -67,7 +107,9 @@ import re
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
-RULES = ("host-sync", "retrace-hazard", "dtype-drift", "nondeterminism")
+RULES = ("host-sync", "retrace-hazard", "dtype-drift", "nondeterminism",
+         "collective-mismatch", "divergent-collective",
+         "scatter-divisibility", "replication-leak")
 
 # float32 finite range; literals outside it (except 0) drift under jit
 _F32_MAX = 3.4028235e38
@@ -81,6 +123,31 @@ _DEVICE_MODULES = {"jnp", "lax"}          # jnp.x(...) / lax.x(...)
 _DEVICE_JAX_SUBMODULES = {"lax", "nn", "numpy", "random", "scipy"}
 # fetch APIs whose results are HOST values (the sanctioned sync points)
 _HOST_FETCHES = {("jax", "device_get")}
+# SPMD collectives (blocking cross-shard comms).  A shard that skips
+# one while its peers enter it deadlocks the mesh on real hardware —
+# the hazard class behind divergent-collective.
+_COMM_COLLECTIVES = {"psum", "psum_scatter", "pmean", "pmax", "pmin",
+                     "all_gather", "all_to_all", "ppermute", "pshuffle"}
+# collectives whose RESULT is bitwise-replicated across the axis
+# (clears the shard-local taint)…
+_REPLICATED_RESULT = {"psum", "pmean", "pmax", "pmin", "all_gather"}
+# …and primitives whose result is shard-VARYING by construction
+# (sets the taint)
+_SHARD_LOCAL_RESULT = {"psum_scatter", "all_to_all", "ppermute",
+                       "pshuffle", "axis_index"}
+# package helpers whose documented contract is a replicated result
+# (ops/split.combine_sharded_records: all_gather + identical argmax on
+# every shard) — the taint lattice treats them like psum
+_REPLICATING_HELPERS = {"combine_sharded_records"}
+# 0-based position of the axis-name argument
+_COLLECTIVE_AXIS_POS = {"axis_index": 0}
+# keyword names that carry mesh-axis bindings at call sites
+# (functools.partial(build_tree, data_axis="data") and friends)
+_AXIS_KWARG = re.compile(r"(^axis_name$)|(_axis$)")
+# divisibility-guard helpers recognized by scatter-divisibility
+# (learner/common.py: padding and the trace-time ValueError guard)
+_PAD_HELPERS = {"pad_cols_to_ndev", "check_scatter_divisible"}
+
 _TRACE_WRAPPER_FN_ARGS = {
     # callee suffix -> 0-based positions of traced-function arguments
     "fori_loop": (2,),
@@ -119,6 +186,7 @@ class FuncInfo:
     tracer_params: Set[str] = field(default_factory=set)
     traced: bool = False
     is_jit_root: bool = False          # has its own jit cache + statics
+    smap: bool = False                 # reachable from a shard_map body
 
 
 @dataclass
@@ -159,6 +227,43 @@ def _devicey_chain(chain: Optional[Tuple[str, ...]]) -> bool:
                                  "process_index", "devices",
                                  "local_devices", "default_backend")
     return False
+
+
+def _collective_name(node: ast.AST) -> Optional[str]:
+    """'psum' / 'all_gather' / … / 'axis_index' when `node` is the
+    callee of an SPMD collective (jax.lax.psum, lax.psum, or a bare
+    from-import name); None otherwise."""
+    chain = _attr_chain(node)
+    if not chain:
+        return None
+    name = chain[-1]
+    if name not in _COMM_COLLECTIVES and name != "axis_index":
+        return None
+    if len(chain) == 1 or chain[0] in ("jax", "lax"):
+        return name
+    return None
+
+
+def _collective_axis_arg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    """The axis-name argument expression of a collective call."""
+    pos = _COLLECTIVE_AXIS_POS.get(name, 1)
+    if pos < len(call.args):
+        return call.args[pos]
+    for kw in call.keywords:
+        if kw.arg == "axis_name":
+            return kw.value
+    return None
+
+
+def _str_constants(expr: ast.AST) -> Set[str]:
+    """Every string literal inside `expr` (an axis argument may be a
+    name, a tuple of names, or a conditional like
+    `"data" if dd > 1 else None`)."""
+    out: Set[str] = set()
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            out.add(n.value)
+    return out
 
 
 def _attr_chain(node: ast.AST) -> Optional[Tuple[str, ...]]:
@@ -367,6 +472,87 @@ class Package:
             self._device_attrs = dev - host
         return self._device_attrs
 
+    def mesh_axes(self) -> Set[str]:
+        """Union of mesh axis names constructed anywhere in the linted
+        tree: string literals in the axis-names argument of ``Mesh(…)``
+        / ``make_mesh(…)`` calls and in ``axis_names=`` keywords.  Empty
+        when no mesh is built here (partial-tree lint runs) — the
+        axis-name checks then stand down rather than flag everything."""
+        if not hasattr(self, "_mesh_axes"):
+            axes: Set[str] = set()
+            for mi in self.modules.values():
+                for node in ast.walk(mi.tree):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    chain = _attr_chain(node.func)
+                    # Mesh(devices, axis_names) and the modern
+                    # jax.make_mesh(axis_shapes, axis_names) both carry
+                    # the names at position 1
+                    if chain and chain[-1] in ("Mesh", "make_mesh") \
+                            and len(node.args) >= 2:
+                        axes |= _str_constants(node.args[1])
+                    for kw in node.keywords:
+                        if kw.arg == "axis_names":
+                            axes |= _str_constants(kw.value)
+            self._mesh_axes = axes
+        return self._mesh_axes
+
+    def func_has_collective(self, fi: Optional[FuncInfo],
+                            _seen: Optional[Set[int]] = None) -> bool:
+        """Does `fi` perform a blocking SPMD collective, directly or
+        through same-package calls?  (axis_index is not a comm op and
+        does not count.)"""
+        if fi is None:
+            return False
+        if not hasattr(self, "_coll_memo"):
+            self._coll_memo: Dict[int, bool] = {}
+        memo = self._coll_memo
+        if id(fi) in memo:
+            return memo[id(fi)]
+        seen = _seen if _seen is not None else set()
+        if id(fi) in seen:
+            return False                       # cycle: no new evidence
+        seen.add(id(fi))
+        mi = self.modules[fi.module]
+        result = False
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = _collective_name(node.func)
+            if cname is not None and cname in _COMM_COLLECTIVES:
+                result = True
+                break
+            target = self.resolve_callee(mi, fi.qualname, node.func)
+            if target is not None and target is not fi \
+                    and self.func_has_collective(target, seen):
+                result = True
+                break
+        if _seen is None or result:
+            memo[id(fi)] = result
+        return result
+
+    def branch_has_collective(self, mi: ModuleInfo, qual: str,
+                              expr: ast.AST) -> Optional[bool]:
+        """Whether a lax.cond/lax.switch branch argument performs a
+        collective: True/False when determinable, None when the branch
+        reference cannot be resolved (no false positives on unknowns)."""
+        if isinstance(expr, ast.Lambda):
+            for n in ast.walk(expr.body):
+                if isinstance(n, ast.Call):
+                    cname = _collective_name(n.func)
+                    if cname is not None and cname in _COMM_COLLECTIVES:
+                        return True
+                    target = self.resolve_callee(mi, qual, n.func)
+                    if target is not None \
+                            and self.func_has_collective(target):
+                        return True
+            return False
+        refs = [fn for fn, _extra in self._fn_refs(mi, expr)
+                if fn is not None]
+        if not refs:
+            return None
+        return any(self.func_has_collective(fn) for fn in refs)
+
     # -- resolution -----------------------------------------------------
     def resolve(self, module: str, name: str) -> Optional[FuncInfo]:
         mi = self.modules.get(module)
@@ -473,6 +659,49 @@ class Package:
                         mark(self.resolve(mi.name, ref[0]),
                              tracer_params=False)
 
+        # shard_map reachability (shardlint): the bodies handed to
+        # shard_map / compat_shard_map, then everything they call
+        # (including lax control-flow bodies and partial aliases) — the
+        # region where mesh axes are bound and collectives are legal
+        smap_work: List[FuncInfo] = []
+
+        def mark_smap(fn: Optional[FuncInfo]) -> None:
+            if fn is not None and not fn.smap:
+                fn.smap = True
+                smap_work.append(fn)
+
+        for mi in self.modules.values():
+            for node in ast.walk(mi.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = _attr_chain(node.func)
+                if chain and chain[-1] in ("shard_map", "compat_shard_map") \
+                        and node.args:
+                    for fn, _extra in self._fn_refs(mi, node.args[0]):
+                        mark_smap(fn)
+        seen_s: Set[Tuple[str, str]] = set()
+        while smap_work:
+            fi = smap_work.pop()
+            key = (fi.module, fi.qualname)
+            if key in seen_s:
+                continue
+            seen_s.add(key)
+            mi = self.modules[fi.module]
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                mark_smap(self.resolve_callee(mi, fi.qualname, node.func))
+                ref = _callable_ref(node)
+                if ref is not None:
+                    mark_smap(self.resolve(mi.name, ref[0]))
+                chain = _attr_chain(node.func)
+                if chain and chain[-1] in _TRACE_WRAPPER_FN_ARGS:
+                    for pos in _TRACE_WRAPPER_FN_ARGS[chain[-1]]:
+                        if pos < len(node.args):
+                            for fn, _extra in self._fn_refs(mi,
+                                                            node.args[pos]):
+                                mark_smap(fn)
+
     def _fn_refs(self, mi: ModuleInfo, expr: ast.AST
                  ) -> Iterable[Tuple[Optional[FuncInfo], Optional[Set[str]]]]:
         """FuncInfos referenced by a jit/shard_map/lax-wrapper argument:
@@ -519,6 +748,14 @@ class _Dataflow:
         self.mi = mi
         self.fi = fi
         self.devicey_names: Set[str] = set(fi.tracer_params)
+        # shardlint taint lattice: names KNOWN shard-local (derived from
+        # axis_index / psum_scatter / all_to_all / ppermute with no
+        # intervening replicating collective) vs names KNOWN replicated
+        # (derived from psum-family results / combine_sharded_records).
+        # Everything else — parameters included — is unknown and fires
+        # no rule: the runtime DivergenceSanitizer owns that remainder.
+        self.shard_local_names: Set[str] = set()
+        self.replicated_names: Set[str] = set()
 
     def is_devicey(self, expr: ast.AST) -> bool:
         if isinstance(expr, ast.Name):
@@ -588,6 +825,102 @@ class _Dataflow:
             return self.is_devicey(expr.body) or self.is_devicey(expr.orelse)
         return False
 
+    # -- shardlint taint lattice ---------------------------------------
+    def is_shard_local(self, expr: ast.AST) -> bool:
+        """Provably shard-varying: axis_index / psum_scatter /
+        all_to_all / ppermute results and anything derived from them
+        (conservative through calls: a tainted argument taints the
+        result, except through the replicating collectives/helpers)."""
+        if isinstance(expr, ast.Name):
+            return expr.id in self.shard_local_names
+        if isinstance(expr, ast.Call):
+            cname = _collective_name(expr.func)
+            if cname is not None:
+                if cname in _SHARD_LOCAL_RESULT:
+                    return True
+                if cname in _REPLICATED_RESULT:
+                    return False
+            if isinstance(expr.func, ast.Name) \
+                    and expr.func.id in _REPLICATING_HELPERS:
+                return False
+            chain = _attr_chain(expr.func)
+            if chain and chain[-1] in _REPLICATING_HELPERS:
+                return False
+            if any(self.is_shard_local(a) for a in expr.args) or any(
+                    self.is_shard_local(kw.value) for kw in expr.keywords):
+                return True
+            if isinstance(expr.func, ast.Attribute):       # x.sum() etc.
+                return self.is_shard_local(expr.func.value)
+            return False
+        if isinstance(expr, ast.BinOp):
+            return (self.is_shard_local(expr.left)
+                    or self.is_shard_local(expr.right))
+        if isinstance(expr, ast.UnaryOp):
+            return self.is_shard_local(expr.operand)
+        if isinstance(expr, ast.Compare):
+            return self.is_shard_local(expr.left) or any(
+                self.is_shard_local(c) for c in expr.comparators)
+        if isinstance(expr, ast.BoolOp):
+            return any(self.is_shard_local(v) for v in expr.values)
+        if isinstance(expr, ast.Subscript):
+            return (self.is_shard_local(expr.value)
+                    or self.is_shard_local(expr.slice))
+        if isinstance(expr, ast.Attribute):
+            return self.is_shard_local(expr.value)
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return any(self.is_shard_local(e) for e in expr.elts)
+        if isinstance(expr, ast.IfExp):
+            return (self.is_shard_local(expr.body)
+                    or self.is_shard_local(expr.orelse))
+        return False
+
+    def is_replicated(self, expr: ast.AST) -> bool:
+        """Provably replicated across shards: literals, psum-family /
+        combine_sharded_records results, and pure elementwise math over
+        replicated operands.  Used only to SILENCE divergent-collective
+        on predicates the analysis can vouch for — unknowns stay
+        findings (suppress with a written reason)."""
+        if isinstance(expr, ast.Constant):
+            return True
+        if isinstance(expr, ast.Name):
+            return expr.id in self.replicated_names
+        if isinstance(expr, ast.Call):
+            cname = _collective_name(expr.func)
+            if cname is not None:
+                return cname in _REPLICATED_RESULT
+            if isinstance(expr.func, ast.Name) \
+                    and expr.func.id in _REPLICATING_HELPERS:
+                return True
+            chain = _attr_chain(expr.func)
+            if chain and chain[-1] in _REPLICATING_HELPERS:
+                return True
+            # device math (jnp.sum(replicated) etc.) preserves
+            # replication when every operand is replicated
+            if chain and _devicey_chain(chain) and (expr.args
+                                                    or expr.keywords):
+                return all(self.is_replicated(a) for a in expr.args) \
+                    and all(self.is_replicated(kw.value)
+                            for kw in expr.keywords)
+            return False
+        if isinstance(expr, ast.BinOp):
+            return (self.is_replicated(expr.left)
+                    and self.is_replicated(expr.right))
+        if isinstance(expr, ast.UnaryOp):
+            return self.is_replicated(expr.operand)
+        if isinstance(expr, ast.Compare):
+            return self.is_replicated(expr.left) and all(
+                self.is_replicated(c) for c in expr.comparators)
+        if isinstance(expr, ast.BoolOp):
+            return all(self.is_replicated(v) for v in expr.values)
+        if isinstance(expr, ast.Subscript):
+            return self.is_replicated(expr.value)
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return all(self.is_replicated(e) for e in expr.elts)
+        if isinstance(expr, ast.IfExp):
+            return (self.is_replicated(expr.body)
+                    and self.is_replicated(expr.orelse))
+        return False
+
     def note_assign(self, node: ast.AST) -> None:
         targets: List[ast.AST] = []
         if isinstance(node, ast.Assign):
@@ -602,12 +935,22 @@ class _Dataflow:
         else:
             return
         dev = self.is_devicey(value)
+        sl = self.is_shard_local(value)
+        rep = self.is_replicated(value)
         for t in targets:
             if isinstance(t, ast.Name):
                 if dev:
                     self.devicey_names.add(t.id)
                 else:
                     self.devicey_names.discard(t.id)
+                if sl:
+                    self.shard_local_names.add(t.id)
+                else:
+                    self.shard_local_names.discard(t.id)
+                if rep:
+                    self.replicated_names.add(t.id)
+                else:
+                    self.replicated_names.discard(t.id)
 
 
 def _has_float64(expr: ast.AST) -> Optional[ast.AST]:
@@ -693,7 +1036,169 @@ class _Checker(ast.NodeVisitor):
         if self.traced:
             self._check_traced_call(node, chain)
         self._check_config_static(node)
+        self._check_shard_rules(node, chain)
         self.generic_visit(node)
+
+    # -- shardlint: SPMD collective correctness -------------------------
+    def _check_shard_rules(self, node: ast.Call,
+                           chain: Optional[Tuple[str, ...]]) -> None:
+        axes = self.pkg.mesh_axes()
+        cname = _collective_name(node.func)
+        if cname is not None:
+            axis = _collective_axis_arg(node, cname)
+            consts = _str_constants(axis) if axis is not None else set()
+            for c in sorted(consts):
+                if axes and c not in axes:
+                    self._emit(
+                        node, "collective-mismatch",
+                        f"{cname} over axis '{c}', which is not an axis "
+                        f"of any mesh built here (known axes: "
+                        f"{sorted(axes)}); a mismatched axis_name is an "
+                        "unbound-axis trace error under shard_map — or a "
+                        "reduction over the wrong mesh axis")
+            if consts and self.fi is not None and self.fi.traced \
+                    and not self.fi.smap:
+                self._emit(
+                    node, "collective-mismatch",
+                    f"{cname} over axis "
+                    f"'{'/'.join(sorted(consts))}' in traced code not "
+                    "reachable from any shard_map body: nothing binds "
+                    "the axis, so the trace fails (wrap the caller in "
+                    "shard_map or thread the axis name as a "
+                    "None-guarded parameter)")
+            if cname == "psum_scatter" and self.traced \
+                    and not self._has_divisibility_guard():
+                self._emit(
+                    node, "scatter-divisibility",
+                    "psum_scatter with no static divisibility guarantee "
+                    "for the scattered axis in the enclosing function "
+                    "chain: a size that does not tile the mesh axis is "
+                    "a raw XLA shape error at trace time; pad with "
+                    "learner/common.pad_cols_to_ndev (or guard with "
+                    "`if size % ndev: raise ValueError(...)`)")
+        # axis-parameter bindings at any call site
+        # (functools.partial(build_tree, data_axis="rows") …)
+        for kw in node.keywords:
+            if kw.arg and _AXIS_KWARG.search(kw.arg):
+                for c in sorted(_str_constants(kw.value)):
+                    if axes and c not in axes:
+                        self._emit(
+                            kw.value, "collective-mismatch",
+                            f"axis binding {kw.arg}='{c}' names no axis "
+                            f"of any mesh built here (known axes: "
+                            f"{sorted(axes)}); the collective it reaches "
+                            "will trace with an unbound axis name")
+        # PartitionSpec literals must name real mesh axes too
+        is_pspec = (chain and chain[-1] == "PartitionSpec") or (
+            isinstance(node.func, ast.Name)
+            and self.mi.imports.get(node.func.id, ("", ""))[1]
+            == "PartitionSpec")
+        if is_pspec:
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                for c in sorted(_str_constants(a)):
+                    if axes and c not in axes:
+                        self._emit(
+                            node, "collective-mismatch",
+                            f"PartitionSpec names axis '{c}', which is "
+                            f"not an axis of any mesh built here (known "
+                            f"axes: {sorted(axes)})")
+        # divergent collectives + shard-local control flow
+        is_lax = chain and (len(chain) == 1 or chain[0] in ("jax", "lax"))
+        if is_lax and chain[-1] in ("cond", "switch") and self.traced \
+                and len(node.args) >= 2:
+            pred = node.args[0]
+            if chain[-1] == "cond":
+                branches = list(node.args[1:3])
+            else:
+                b = node.args[1]
+                branches = (list(b.elts)
+                            if isinstance(b, (ast.List, ast.Tuple))
+                            else [b])
+            infos = [self.pkg.branch_has_collective(self.mi, self.qual, b)
+                     for b in branches]
+            known = [i for i in infos if i is not None]
+            any_coll = any(known)
+            pred_sl = self._shard_local(pred)
+            if any_coll and pred_sl:
+                self._emit(
+                    node, "divergent-collective",
+                    f"collective inside a lax.{chain[-1]} branch gated "
+                    "by a shard-local predicate: shards disagree on the "
+                    "branch, some skip the collective, and the mesh "
+                    "deadlocks cross-host; make the predicate "
+                    "replicated (psum the inputs) or hoist the "
+                    "collective out of the branch")
+            elif any_coll and False in known \
+                    and not self._replicated(pred):
+                self._emit(
+                    node, "divergent-collective",
+                    f"collective in only one branch of a "
+                    f"lax.{chain[-1]} whose predicate is not provably "
+                    "replicated: if any shard ever disagrees on the "
+                    "predicate, the shards that skip the branch "
+                    "deadlock the collective; prove the predicate "
+                    "replicated (derive it from psum/"
+                    "combine_sharded_records) or suppress with the "
+                    "replication argument written down")
+            if pred_sl:
+                self._emit(
+                    pred, "replication-leak",
+                    f"shard-local value steers a lax.{chain[-1]} "
+                    "predicate: the growth loops require control flow "
+                    "to be bitwise-replicated across shards (PRs 3-4) — "
+                    "reduce it with psum/all_gather first")
+        if is_lax and chain[-1] == "fori_loop" and self.traced:
+            for bound in node.args[:2]:
+                if self._shard_local(bound):
+                    self._emit(
+                        bound, "replication-leak",
+                        "shard-local value as a fori_loop bound: shards "
+                        "run different trip counts, so any collective "
+                        "in the body deadlocks and replicated state "
+                        "diverges; psum the bound first")
+
+    def _shard_local(self, expr: ast.AST) -> bool:
+        return self.flow is not None and self.flow.is_shard_local(expr)
+
+    def _replicated(self, expr: ast.AST) -> bool:
+        return self.flow is not None and self.flow.is_replicated(expr)
+
+    def _has_divisibility_guard(self) -> bool:
+        """Static divisibility evidence for psum_scatter in the lexical
+        function chain: an assert with a `%` test, an `if … % …: raise`
+        guard, pad-to-multiple arithmetic `nd * ((x + nd - 1) // nd)`,
+        or a pad_cols_to_ndev call."""
+        if self.fi is None:
+            return False
+        chain_fis = [self.fi]
+        parts = self.fi.qualname.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            anc = self.mi.funcs.get(".".join(parts[:cut]))
+            if anc is not None and anc not in chain_fis:
+                chain_fis.append(anc)
+
+        def has_mod(expr: ast.AST) -> bool:
+            return any(isinstance(b, ast.BinOp) and isinstance(b.op, ast.Mod)
+                       for b in ast.walk(expr))
+
+        for fi in chain_fis:
+            for n in ast.walk(fi.node):
+                if isinstance(n, ast.Assert) and has_mod(n.test):
+                    return True
+                if isinstance(n, ast.If) and has_mod(n.test) and any(
+                        isinstance(s, ast.Raise) for s in n.body):
+                    return True
+                if isinstance(n, ast.Call):
+                    cchain = _attr_chain(n.func)
+                    if cchain and cchain[-1] in _PAD_HELPERS:
+                        return True
+                if isinstance(n, ast.BinOp) and isinstance(n.op, ast.Mult):
+                    for a, b in ((n.left, n.right), (n.right, n.left)):
+                        if isinstance(b, ast.BinOp) \
+                                and isinstance(b.op, ast.FloorDiv) \
+                                and ast.dump(b.right) == ast.dump(a):
+                            return True
+        return False
 
     def _check_traced_call(self, node: ast.Call,
                            chain: Optional[Tuple[str, ...]]) -> None:
@@ -897,11 +1402,54 @@ def load_allowlist(path: str) -> Dict[Tuple[str, str, str], str]:
 
 
 def lint_paths(paths: Sequence[str], root: str,
-               allowlist: Optional[Dict[Tuple[str, str, str], str]] = None
+               allowlist: Optional[Dict[Tuple[str, str, str], str]] = None,
+               used_allowlist: Optional[Set[Tuple[str, str, str]]] = None
                ) -> List[Finding]:
     """Run every rule over `paths` (files or directories).  Returns
     unsuppressed findings; suppressions without a reason are findings
-    themselves (`suppression` rule)."""
+    themselves (`suppression` rule).  When `used_allowlist` is given it
+    is filled with the allowlist keys that actually matched a finding —
+    the input of the stale-entry check (stale_allowlist_entries)."""
+    findings, _stale = lint_run(paths, root, allowlist,
+                                used_allowlist=used_allowlist,
+                                check_stale=False)
+    return findings
+
+
+def stale_allowlist_entries(
+        allowlist: Dict[Tuple[str, str, str], str],
+        used: Set[Tuple[str, str, str]],
+        linted_paths: Set[str], root: str) -> List[str]:
+    """Allowlist entries that no longer earn their keep: the file was
+    linted and the key matched no finding (fix landed, or the qualname
+    was renamed), or the file no longer exists.  Entries for files
+    outside the linted set are left alone, and CALLERS must only run
+    this audit over the whole package — whether an entry still produces
+    its finding can depend on cross-file context (traced-reachability,
+    mesh axes), so a partial-tree run cannot judge even its own files
+    (scripts/run_lint.py gates on full scope).  Mirrors
+    check_config_coverage.py's stale-allowlist rule: the list may only
+    shrink consciously."""
+    out: List[str] = []
+    for (path, rule, qual), _reason in sorted(allowlist.items()):
+        if (path, rule, qual) in used:
+            continue
+        if path in linted_paths:
+            out.append(f"{path}::{rule}::{qual} — no longer produces a "
+                       "finding; remove the entry")
+        elif not os.path.exists(os.path.join(root, path)):
+            out.append(f"{path}::{rule}::{qual} — file no longer exists; "
+                       "remove the entry")
+    return out
+
+
+def lint_run(paths: Sequence[str], root: str,
+             allowlist: Optional[Dict[Tuple[str, str, str], str]] = None,
+             used_allowlist: Optional[Set[Tuple[str, str, str]]] = None,
+             check_stale: bool = True
+             ) -> Tuple[List[Finding], List[str]]:
+    """lint_paths plus the stale-allowlist audit: returns
+    (findings, stale-entry descriptions)."""
     pkg = Package(root)
     for p in paths:
         if os.path.isdir(p):
@@ -910,6 +1458,9 @@ def lint_paths(paths: Sequence[str], root: str,
             pkg.add_file(p)
     pkg.mark_traced()
     allowlist = allowlist or {}
+    used: Set[Tuple[str, str, str]] = (used_allowlist
+                                       if used_allowlist is not None
+                                       else set())
 
     raw: List[Finding] = []
     for mi in pkg.modules.values():
@@ -945,6 +1496,7 @@ def lint_paths(paths: Sequence[str], root: str,
             continue
         wl = allowlist.get((f.path, f.rule, f.qualname))
         if wl is not None:
+            used.add((f.path, f.rule, f.qualname))
             if wl:
                 continue
             findings.append(Finding(
@@ -952,4 +1504,8 @@ def lint_paths(paths: Sequence[str], root: str,
                 "allowlist entry has no reason", f.qualname))
             continue
         findings.append(f)
-    return findings
+    stale: List[str] = []
+    if check_stale:
+        linted = {m.path for m in pkg.modules.values()}
+        stale = stale_allowlist_entries(allowlist, used, linted, root)
+    return findings, stale

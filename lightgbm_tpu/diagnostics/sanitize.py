@@ -19,12 +19,24 @@ Two mechanisms, wrapped in one context manager:
   on the ``jax`` logger counts "Compiling <name>" records per step;
   compiles after the declared warmup increment ``sanitize/retraces``.
 
+A third mechanism, ``DivergenceSanitizer``, is the runtime half of the
+shardlint static rules (lint.py): under ``BENCH_SANITIZE=1`` both mesh
+learners fingerprint the replicated growth-loop state (the packed tree
+arrays and leaf counts — the materialization of the split records after
+``combine_sharded_records``) on every device each iteration and
+hard-fail on any cross-shard bitwise mismatch — the failure mode
+``shard_map(..., check_vma=False)`` cannot see and a 2-D mesh turns
+into a pod-wide deadlock.
+
 Counters land in the always-on profiling registry
 (``sanitize/retraces``, ``sanitize/implicit_transfers``,
-``sanitize/compiles_total``), so bench.py records them in its JSON line
+``sanitize/compiles_total``, ``sanitize/divergence_checks``,
+``sanitize/divergences``), so bench.py records them in its JSON line
 and the /stats endpoint can expose them.  ``BENCH_SANITIZE=1`` modes in
 bench.py / scripts/bench_serve.py / scripts/profile_hotpath.py and the
-MULTICHIP dryrun gate assert both are zero after warmup.
+MULTICHIP dryrun gate assert all of them are zero after warmup (with
+``divergence_checks > 0`` proving the divergence probe actually ran on
+multi-device meshes).
 
 Backend caveat: the guard is enforced by the backend's dispatch layer
 and is a no-op for some transfer directions on some platforms (e.g.
@@ -45,6 +57,8 @@ from .. import profiling
 RETRACES = "sanitize/retraces"
 IMPLICIT_TRANSFERS = "sanitize/implicit_transfers"
 COMPILES_TOTAL = "sanitize/compiles_total"
+DIVERGENCE_CHECKS = "sanitize/divergence_checks"
+DIVERGENCES = "sanitize/divergences"
 
 # Retrace signal: "Finished tracing + transforming <name> for pjit" fires
 # on every (re)trace, INCLUDING compiles served from the persistent
@@ -85,6 +99,118 @@ def transfer_guard_effective() -> bool:
     except Exception as e:      # noqa: BLE001 — backend-specific error type
         return _is_transfer_guard_error(e)
     return False
+
+
+def _replica_digests(x) -> list:
+    """(device, sha1-digest) per REPLICATED copy of `x`: every
+    addressable shard whose buffer covers the whole array.  Fewer than
+    two full copies (sharded arrays, single device) → [] — there is
+    nothing cross-shard to compare.  Fetches are explicit
+    ``jax.device_get`` so the probe stays legal under the transfer
+    guard's "disallow"."""
+    import hashlib
+
+    import jax
+    import numpy as np
+    shards = getattr(x, "addressable_shards", None)
+    if not shards or len(shards) < 2:
+        return []
+    out = []
+    for s in shards:
+        if tuple(s.data.shape) != tuple(x.shape):
+            return []                  # genuinely sharded, not replicated
+        buf = np.ascontiguousarray(jax.device_get(s.data))
+        out.append((s.device, hashlib.sha1(buf.tobytes()).hexdigest()))
+    return out
+
+
+class DivergenceSanitizer:
+    """Cross-shard replication checker — the runtime half of shardlint.
+
+    The static rules (diagnostics/lint.py shardlint family) prove what
+    the AST can see; this closes over what it cannot: whether the
+    REPLICATED growth-loop state (split records post-
+    ``combine_sharded_records``, leaf counts, the packed tree arrays)
+    is actually bitwise-identical on every device after each iteration.
+    The mesh learners run ``shard_map(..., check_vma=False)``, so a
+    shard-local value leaking into replicated control flow produces
+    per-device buffers that silently disagree — a wrong answer on CPU
+    and the prelude to a pod-wide deadlock on real hardware.
+
+    ``check(name, value)`` fingerprints every jax.Array leaf of a
+    pytree per device (sha1 over the raw buffer) and compares:
+    identical → one ``sanitize/divergence_checks`` tick; any mismatch →
+    ``sanitize/divergences`` plus (strict mode, the default) an
+    immediate AssertionError naming the leaf and per-device digests.
+    Multi-process runs compare this process's addressable devices; the
+    cross-host copies are covered by every host running the same check.
+    """
+
+    def __init__(self, label: str = "growth-loop", strict: bool = True):
+        self.label = label
+        self.strict = strict
+        self.checks = 0
+        self.divergences = 0
+        self.evidence = []
+
+    def check(self, name: str, value) -> int:
+        """Fingerprint a pytree of (assumed-replicated) device arrays.
+        Returns the number of NEW divergences found."""
+        import jax
+        before = self.divergences
+        try:
+            items = [(jax.tree_util.keystr(p), leaf) for p, leaf in
+                     jax.tree_util.tree_leaves_with_path(value)]
+        except AttributeError:         # older jax: positional labels
+            items = [(str(i), leaf) for i, leaf in
+                     enumerate(jax.tree_util.tree_leaves(value))]
+        for key, leaf in items:
+            digs = _replica_digests(leaf)
+            if len(digs) < 2:
+                continue
+            self.checks += 1
+            profiling.count(DIVERGENCE_CHECKS)
+            if len({d for _, d in digs}) > 1:
+                self.divergences += 1
+                profiling.count(DIVERGENCES)
+                ev = (name, key, [(str(dev), d[:12]) for dev, d in digs])
+                if len(self.evidence) < 16:
+                    self.evidence.append(ev)
+                if self.strict:
+                    raise AssertionError(
+                        f"cross-shard divergence [{self.label}] in "
+                        f"'{name}/{key}': a replicated growth-loop value "
+                        f"differs across devices {ev[2]} — a shard-local "
+                        "value leaked into replicated state (silent "
+                        "wrong answer here, deadlock shape on a real "
+                        "mesh)")
+        return self.divergences - before
+
+    def report(self) -> dict:
+        return {"label": self.label,
+                "divergence_checks": self.checks,
+                "divergences": self.divergences,
+                "evidence": self.evidence[:4]}
+
+
+_divergence: Optional[DivergenceSanitizer] = None
+
+
+def divergence_sanitizer() -> DivergenceSanitizer:
+    """The process-wide strict instance the learner hooks feed."""
+    global _divergence
+    if _divergence is None:
+        _divergence = DivergenceSanitizer(label="hot-path")
+    return _divergence
+
+
+def maybe_check_divergence(name: str, value) -> None:
+    """Hot-loop hook (both mesh learners call this after every tree
+    build): no-op unless BENCH_SANITIZE is on, else a strict
+    cross-shard replication check of `value`."""
+    if not sanitize_enabled():
+        return
+    divergence_sanitizer().check(name, value)
 
 
 class _CompileCounter(logging.Handler):
@@ -154,6 +280,12 @@ class HotPathSanitizer:
         self.compiles_total = 0
         self.trace_events = 0
         self.compile_names = []
+        # cross-shard divergence counters over this window (the
+        # DivergenceSanitizer feeds the profiling registry; the deltas
+        # land in report()/check() beside the retrace counters)
+        self.divergence_checks = 0
+        self.divergences = 0
+        self._div0 = (0.0, 0.0)
         self._handler: Optional[_CompileCounter] = None
         self._prev_log_compiles = None
         self._prev_propagate = None
@@ -169,6 +301,8 @@ class HotPathSanitizer:
         lg.propagate = False
         self._prev_log_compiles = jax.config.jax_log_compiles
         jax.config.update("jax_log_compiles", True)
+        self._div0 = (profiling.counter_value(DIVERGENCE_CHECKS),
+                      profiling.counter_value(DIVERGENCES))
         return self
 
     def __exit__(self, exc_type, exc, tb) -> bool:
@@ -183,6 +317,10 @@ class HotPathSanitizer:
         profiling.count(RETRACES, self.retraces)
         profiling.count(IMPLICIT_TRANSFERS, self.implicit_transfers)
         profiling.count(COMPILES_TOTAL, self.compiles_total)
+        self.divergence_checks = int(
+            profiling.counter_value(DIVERGENCE_CHECKS) - self._div0[0])
+        self.divergences = int(
+            profiling.counter_value(DIVERGENCES) - self._div0[1])
         return False
 
     # -- per-iteration accounting --------------------------------------
@@ -235,17 +373,24 @@ class HotPathSanitizer:
             "implicit_transfers": self.implicit_transfers,
             "trace_events_total": self.trace_events,
             "compiles_total": self.compiles_total,
+            # cross-shard replication audit over this window (the
+            # DivergenceSanitizer; >0 checks only on multi-device
+            # meshes with BENCH_SANITIZE on)
+            "divergence_checks": self.divergence_checks,
+            "divergences": self.divergences,
             # first offending program names — the evidence a regression
             # report needs to find the retracing call site
             "retrace_names": self.compile_names[-8:] if self.retraces else [],
         }
 
     def check(self) -> None:
-        """Raise with a diagnostic when the zero/zero contract is broken."""
-        if self.retraces or self.implicit_transfers:
+        """Raise with a diagnostic when the zero/zero/zero contract is
+        broken (retraces, implicit transfers, cross-shard divergences)."""
+        if self.retraces or self.implicit_transfers or self.divergences:
             raise AssertionError(
                 f"hot-path sanitizer [{self.label}]: "
-                f"{self.retraces} retrace(s) and "
-                f"{self.implicit_transfers} implicit transfer(s) after "
+                f"{self.retraces} retrace(s), "
+                f"{self.implicit_transfers} implicit transfer(s) and "
+                f"{self.divergences} cross-shard divergence(s) after "
                 f"{self.warmup} warmup step(s) over {self.steps} steps; "
                 f"recent compiles: {self.compile_names[-8:]}")

@@ -178,6 +178,10 @@ def allgather_f64(arr) -> "np.ndarray":
     a = np.ascontiguousarray(np.asarray(arr, np.float64))
     words = a.view(np.uint32)
     out = np.asarray(multihost_utils.process_allgather(words))
+    # process_allgather returns [W, *words.shape] on a multi-process
+    # world but the bare words.shape when W == 1 — normalize so the
+    # documented [world, *arr.shape] contract holds for every caller
+    out = np.ascontiguousarray(out).reshape((-1,) + words.shape)
     return out.view(np.float64)
 
 

@@ -87,6 +87,61 @@ def compat_shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
                      check_rep=check_vma)
 
 
+def pad_cols_to_ndev(n_cols: int, ndev: int, align: int = 1) -> int:
+    """Smallest column count >= `n_cols` that tiles the mesh data axis
+    for the psum_scatter histogram exchange: a multiple of
+    lcm(ndev, align) (`align` carries a kernel layout constraint, e.g.
+    the int8 store's 32-sublane grouping; pass ndev = data*feature for
+    a 2-D mesh, where the per-feature-shard slice must itself tile the
+    data axis).  Raises a clear ValueError on degenerate mesh sizes
+    instead of letting lax.psum_scatter fail with a raw XLA tiling
+    error downstream."""
+    if ndev < 1 or align < 1:
+        raise ValueError(
+            f"pad_cols_to_ndev: mesh axis size ({ndev}) and alignment "
+            f"({align}) must be >= 1; a zero-sized data axis cannot be "
+            "tiled by any column padding")
+    unit = math.lcm(int(ndev), int(align))
+    return unit * int(math.ceil(max(int(n_cols), 1) / unit))
+
+
+def check_scatter_divisible(axis: str, size: int, ndev: int) -> None:
+    """Trace-time guard in front of `lax.psum_scatter`: raise a clear
+    ValueError naming the axis, its size, and the mesh axis size when
+    the scattered axis cannot tile the mesh.  The learners pad their
+    stores with pad_cols_to_ndev so this never fires on the built-in
+    paths; a caller wiring build_tree* directly without padding used to
+    get a bare `assert` (gone under `python -O`, leaving XLA's raw
+    shape error at the psum_scatter dispatch)."""
+    if ndev > 1 and size % ndev:
+        raise ValueError(
+            f"psum_scatter needs the scattered axis '{axis}' (size "
+            f"{size}) to be a multiple of the mesh data-axis size "
+            f"({ndev}); pad the store columns with "
+            f"learner.common.pad_cols_to_ndev "
+            f"({pad_cols_to_ndev(size, ndev)} would tile)")
+
+
+def check_tree_divergence(name: str, arrs, packed=None) -> None:
+    """BENCH_SANITIZE divergence gate shared by both mesh learners
+    (diagnostics/sanitize.py): the tree a build returned is replicated
+    state — every device must hold the bitwise-identical copy, or a
+    shard-local value leaked into the growth loop's control flow.
+    Fingerprints one pytree shape for both learners (the packed tree
+    vector plus leaf counts) so their divergence reports stay
+    comparable across tree_growth modes.  No-op (one env read) unless
+    the sanitizer is enabled; `packed` is computed only then when the
+    caller has not already paid for it."""
+    from ..diagnostics import sanitize
+    if not sanitize.sanitize_enabled():
+        return
+    if packed is None:
+        from .fused import pack_tree_arrays
+        packed = pack_tree_arrays(arrs)
+    sanitize.maybe_check_divergence(name, {"packed_tree": packed,
+                                           "leaf_count": arrs.leaf_count})
+
+
 def make_split_kw(cfg: Config) -> tuple:
     """Hashable (static-arg) split hyperparameters for ops.split.best_split
     (reference feature_histogram.hpp:281-300 gain math inputs)."""

@@ -36,8 +36,10 @@ import numpy as np
 
 from ..config import Config
 from ..dataset import Dataset
-from .common import (make_split_kw, padded_bin_count, resolve_hist_exchange,
-                     sentinel_bins_t, use_parent_hist_cache)
+from .common import (check_scatter_divisible, check_tree_divergence,
+                     make_split_kw, pad_cols_to_ndev, padded_bin_count,
+                     resolve_hist_exchange, sentinel_bins_t,
+                     use_parent_hist_cache)
 from ..jaxutil import bag_mask_dev, pad_rows_dev, slice_rows_dev
 from ..ops.histogram import histogram_full_masked
 from ..ops.split import (best_split, bundle_predicate_params,
@@ -119,9 +121,9 @@ def build_tree(bins, grad, hess, row_mask, num_bins, is_cat, fmask, ftbl,
     hx_vote = hist_exchange == "psum_scatter" and voting
     nd = num_machines if data_axis is not None else 1
     if hx:
-        assert Floc % nd == 0, (
-            f"psum_scatter needs store columns ({Floc}) divisible by the "
-            f"data-axis size ({nd}); the learner pads the store")
+        # trace-time guard with a named ValueError (the learner pads the
+        # store, so only direct build_tree callers can trip it)
+        check_scatter_divisible("store columns", Floc, nd)
     Fs = Floc // nd if hx else Floc
 
     def make_local_hist(mask):
@@ -214,7 +216,7 @@ def build_tree(bins, grad, hess, row_mask, num_bins, is_cat, fmask, ftbl,
             # data-axis multiple by repeating slot 0 — duplicates yield
             # identical records, which the argmax collapses), search this
             # shard's slots only, then allgather + argmax the records
-            k2p = nd * ((k2 + nd - 1) // nd)
+            k2p = pad_cols_to_ndev(k2, nd)
             selp = jnp.concatenate(
                 [sel, jnp.broadcast_to(sel[:1], (k2p - k2,))]) \
                 if k2p > k2 else sel
@@ -555,8 +557,9 @@ class FusedTreeLearner:
         hx_pad = (self.hist_exchange == "psum_scatter" and self.dd > 1
                   and not voting)
         if hx_pad and not self.use_bundle:
-            fd = self.df * self.dd
-            self.Fp = int(fd * math.ceil(self.F / fd))
+            # each feature shard's Fp/df column slice must itself tile
+            # the data axis, so the unit is the full df*dd product
+            self.Fp = pad_cols_to_ndev(self.F, self.df * self.dd)
         if self.use_bundle:
             store = dataset.bins
             bins_np = store.astype(np.int32)
@@ -567,8 +570,7 @@ class FusedTreeLearner:
             if hx_pad and self.Cstore % self.dd:
                 # trivial zero columns so the bundled store tiles the
                 # data axis (the unbundle sentinel must sit past them)
-                cp = self.dd * int(math.ceil(self.Cstore / self.dd)) \
-                    - self.Cstore
+                cp = pad_cols_to_ndev(self.Cstore, self.dd) - self.Cstore
                 bins_np = np.pad(bins_np, ((0, cp), (0, 0)))
                 self.Cstore += cp
         else:
@@ -734,6 +736,7 @@ class FusedTreeLearner:
             self.bins_dev, self._pad_rows(grad), self._pad_rows(hess), mask,
             self.num_bins_dev, self.is_cat_dev, self._feature_mask())
         self._record_comm_stats()
+        check_tree_divergence("fused/tree", arrs)
         tree = tree_arrays_to_host(arrs, self.dataset,
                                    self.config.num_leaves)
         if self.mh is not None:

@@ -45,10 +45,11 @@ import numpy as np
 
 from ..config import Config
 from ..dataset import Dataset
-from .common import (gather_capacity_tiers, gather_scratch_capacity,
-                     make_split_kw, padded_bin_count, resolve_hist_exchange,
-                     resolve_hist_rows, sentinel_bins_t,
-                     use_parent_hist_cache)
+from .common import (check_scatter_divisible, check_tree_divergence,
+                     gather_capacity_tiers, gather_scratch_capacity,
+                     make_split_kw, pad_cols_to_ndev, padded_bin_count,
+                     resolve_hist_exchange, resolve_hist_rows,
+                     sentinel_bins_t, use_parent_hist_cache)
 from .fused import TreeArrays, tree_arrays_to_host
 from ..jaxutil import bag_mask_dev, pad_rows_dev, slice_rows_dev, \
     unstack_scalars
@@ -194,9 +195,9 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
     hx = hist_exchange == "psum_scatter" and data_axis is not None
     nd = num_devices if data_axis is not None else 1
     if hx:
-        assert F % nd == 0, (
-            f"psum_scatter needs store columns ({F}) divisible by the "
-            f"data-axis size ({nd}); the learner pads the store")
+        # trace-time guard with a named ValueError (the learner pads the
+        # store, so only direct build_tree_rounds callers can trip it)
+        check_scatter_divisible("store columns", F, nd)
     Fs = F // nd if hx else F
 
     def exchange(h):
@@ -636,6 +637,7 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
             def skip_chunk(args):
                 return args
 
+            # graftlint: allow(divergent-collective) — dk slices `do`, derived from the replicated leaf_best records (psum/combine_sharded_records outputs carried through the while_loop), so every shard computes the identical predicate and takes the same branch; the DivergenceSanitizer checks the products at run time
             leaf_best2, leaf_hist2, stats2 = jax.lax.cond(
                 jnp.any(dk), do_chunk, skip_chunk,
                 (leaf_best2, leaf_hist2, stats2))
@@ -722,9 +724,9 @@ class RoundsTreeLearner:
             config, ndev=self.dd,
             payload_bytes=4.0 * K_pass * self.Fpad * 3 * self.B)
         if self.hist_exchange == "psum_scatter" and self.dd > 1:
-            align = math.lcm(self.dd,
-                             32 if bins_np.dtype == np.int8 else 1)
-            self.Fpad = align * int(math.ceil(self.Fpad / align))
+            self.Fpad = pad_cols_to_ndev(
+                self.Fpad, self.dd,
+                align=32 if bins_np.dtype == np.int8 else 1)
         # pad value must be an in-range bin; padded rows/features carry
         # zero mask so their bin never matters
         pad_val = -128 if bins_np.dtype == np.int8 else 0
@@ -906,7 +908,9 @@ class RoundsTreeLearner:
         # device scalars, folded into the counters at the next metrics
         # read — no sync on the pipelined path
         self._record_stats(profiling, stats)
-        return pack_tree_arrays(arrs), slice_rows_dev(leaf_id, n=self.N), arrs
+        packed = pack_tree_arrays(arrs)
+        check_tree_divergence("rounds/tree", arrs, packed)
+        return packed, slice_rows_dev(leaf_id, n=self.N), arrs
 
     def _record_stats(self, profiling, stats) -> None:
         # one jitted unstack: eager stats[i] indexing lowers to
@@ -925,6 +929,7 @@ class RoundsTreeLearner:
             self.bins_dev, self._pad_rows(grad), self._pad_rows(hess), mask,
             self.num_bins_dev, self.is_cat_dev, fmask)
         self._record_stats(profiling, stats)
+        check_tree_divergence("rounds/tree", arrs)
         tree = tree_arrays_to_host(arrs, self.dataset, self.config.num_leaves)
         if self.mh is not None:
             return tree, jnp.asarray(self.mh.local_rows(leaf_id))

@@ -210,7 +210,15 @@ def combine_sharded_records(recs: jax.Array, axis_name) -> jax.Array:
     bundles order shards by store column, not original feature).
 
     recs: [..., 11] (a single record or a [K, 11] batch); returns the
-    same shape, replicated across the axis."""
+    same shape, replicated across the axis.
+
+    REPLICATION CONTRACT: every shard receives the identical winning
+    record (all_gather is replicated and the argmin over it is
+    deterministic), so results may legally gate replicated control
+    flow.  shardlint's taint lattice (diagnostics/lint.py) encodes this
+    by name — treat this function like psum when reasoning about
+    divergence — and the DivergenceSanitizer checksums the downstream
+    tree state at run time."""
     allr = jax.lax.all_gather(recs, axis_name)       # [nd, ..., 11]
     gains = allr[..., 0]
     mx = jnp.max(gains, axis=0, keepdims=True)

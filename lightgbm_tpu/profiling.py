@@ -47,6 +47,12 @@ _deferred: Dict[str, object] = {}
 #  - SPLIT_RECORDS_BYTES: per-device bytes of the psum_scatter path's
 #    best-split-record allgather ([ndev, K, 11] f32 per pass; zero
 #    under psum, which exchanges no records).
+# The BENCH_SANITIZE divergence audit (diagnostics/sanitize.py
+# DivergenceSanitizer) feeds two more counters through count():
+# sanitize/divergence_checks (cross-shard fingerprint comparisons of
+# the replicated tree state) and sanitize/divergences (bitwise
+# mismatches — the hard-fail condition); bench.py and the MULTICHIP
+# dryrun record both beside the retrace/transfer counters.
 HIST_ROWS_TOUCHED = "tree/hist_rows_touched"
 HIST_EXCHANGE_BYTES = "tree/hist_exchange_bytes"
 SPLIT_RECORDS_BYTES = "tree/split_records_bytes"

@@ -15,10 +15,23 @@ mkdir -p .bench
 touch "$MARK"
 
 # Preflight: the chip window must never burn minutes on a hot path the
-# static analysis already knows is broken (graftlint — retrace/transfer
-# hazards fail HERE, on the host, before the TPU queue).  Milliseconds,
-# no jax import.
-python scripts/run_lint.py || { echo "!! graftlint preflight FAILED — fix findings before burning chip time"; exit 1; }
+# static analysis already knows is broken (graftlint + shardlint —
+# retrace/transfer/collective hazards fail HERE, on the host, before
+# the TPU queue).  Milliseconds, no jax import.  The machine-readable
+# findings land in .bench/preflight_lint.json so a failed preflight
+# leaves an annotatable artifact.
+if ! python scripts/run_lint.py --json > .bench/preflight_lint.json; then
+  python - <<'PY'
+import json
+r = json.load(open(".bench/preflight_lint.json"))
+for f in r["findings"]:
+    print(f"{f['file']}:{f['line']}: {f['rule']}: {f['message']} [in {f['qualname']}]")
+for s in r["stale_allowlist"]:
+    print(f"stale allowlist entry: {s}")
+PY
+  echo "!! graftlint preflight FAILED — fix findings before burning chip time"
+  exit 1
+fi
 
 stage() {  # stage <name> <cmd...>  (stdout tees to .bench/<name>.log)
   local name=$1; shift

@@ -1,17 +1,26 @@
-"""graftlint CLI — JAX-hazard static analysis over the package.
+"""graftlint CLI — JAX-hazard + SPMD-collective static analysis.
 
 Prints `path:line: rule: message [in qualname]` findings and exits
 nonzero when any survive suppressions and the reviewed allowlist
-(scripts/lint_allowlist.txt).  Run from tier-1
-(tests/test_lint_clean.py), the chip-queue preflight
-(scripts/run_chip_queue.sh), and standalone:
+(scripts/lint_allowlist.txt) — or when an allowlist entry has gone
+STALE (its path::rule::qualname no longer exists or no longer produces
+a finding), mirroring the stale-allowlist rule
+scripts/check_config_coverage.py enforces for config keys: the
+allowlist can only shrink consciously.
 
-    python scripts/run_lint.py [paths...]
+`--json` emits machine-readable findings on stdout
+(file/line/rule/qualname/message plus the stale entries) with a
+one-line summary on stderr, for the chip-queue preflight and CI
+annotation.  Run from tier-1 (tests/test_lint_clean.py), the
+chip-queue preflight (scripts/run_chip_queue.sh), and standalone:
+
+    python scripts/run_lint.py [--json] [paths...]
 
 Stdlib-only (no jax import): the gate costs milliseconds.
 """
 import argparse
 import importlib.util
+import json
 import os
 import sys
 
@@ -26,7 +35,7 @@ _spec = importlib.util.spec_from_file_location(
 _lint = importlib.util.module_from_spec(_spec)
 sys.modules["graftlint"] = _lint    # dataclasses resolves annotations here
 _spec.loader.exec_module(_lint)
-lint_paths, load_allowlist = _lint.lint_paths, _lint.load_allowlist
+lint_run, load_allowlist = _lint.lint_run, _lint.load_allowlist
 
 ALLOWLIST_FILE = os.path.join(ROOT, "scripts", "lint_allowlist.txt")
 
@@ -36,25 +45,61 @@ def main(argv=None) -> int:
     ap.add_argument("paths", nargs="*",
                     default=[os.path.join(ROOT, "lightgbm_tpu")],
                     help="files or directories (default: the package)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings on stdout "
+                         "(file/line/rule/qualname/message + stale "
+                         "allowlist entries); summary goes to stderr")
+    ap.add_argument("--allowlist", default=ALLOWLIST_FILE,
+                    help="reviewed allowlist file (default: "
+                         "scripts/lint_allowlist.txt)")
     ap.add_argument("--no-allowlist", action="store_true",
-                    help="ignore scripts/lint_allowlist.txt (show "
-                         "everything the rules match)")
+                    help="ignore the allowlist (show everything the "
+                         "rules match; disables the stale-entry check)")
     args = ap.parse_args(argv)
 
-    allow = {} if args.no_allowlist else load_allowlist(ALLOWLIST_FILE)
-    findings = lint_paths([os.path.abspath(p) for p in args.paths], ROOT,
-                          allow)
+    allow = {} if args.no_allowlist else load_allowlist(args.allowlist)
+    paths = [os.path.abspath(p) for p in args.paths]
+    # The stale-allowlist audit needs WHOLE-PACKAGE context: whether an
+    # entry still produces its finding can depend on cross-file
+    # reachability (log.py's entry fires only when ops/histogram.py is
+    # in scope to mark log.warning traced).  Partial-path runs
+    # therefore skip the audit instead of flagging spuriously.
+    pkg_dir = os.path.join(ROOT, "lightgbm_tpu")
+    full_scope = any(p == pkg_dir for p in paths)
+    findings, stale = lint_run(paths, ROOT, allow, check_stale=full_scope)
+    rc = 1 if (findings or stale) else 0
+
+    by_rule = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    if findings or stale:
+        parts = [f"{r}: {n}" for r, n in sorted(by_rule.items())]
+        if stale:
+            parts.append(f"stale-allowlist: {len(stale)}")
+        summary = (f"graftlint: {len(findings)} finding(s), "
+                   f"{len(stale)} stale allowlist entr"
+                   f"{'y' if len(stale) == 1 else 'ies'} "
+                   f"({', '.join(parts)})")
+    else:
+        summary = "graftlint OK: no JAX-hazard findings"
+
+    if args.as_json:
+        print(json.dumps({
+            "ok": rc == 0,
+            "findings": [{"file": f.path, "line": f.line, "rule": f.rule,
+                          "qualname": f.qualname, "message": f.message}
+                         for f in findings],
+            "stale_allowlist": stale,
+        }))
+        print(summary, file=sys.stderr)
+        return rc
+
     for f in findings:
         print(f.render())
-    if findings:
-        by_rule = {}
-        for f in findings:
-            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
-        summary = ", ".join(f"{r}: {n}" for r, n in sorted(by_rule.items()))
-        print(f"graftlint: {len(findings)} finding(s) ({summary})")
-        return 1
-    print("graftlint OK: no JAX-hazard findings")
-    return 0
+    for s in stale:
+        print(f"stale allowlist entry: {s}")
+    print(summary)
+    return rc
 
 
 if __name__ == "__main__":

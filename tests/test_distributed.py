@@ -47,6 +47,138 @@ def test_resolve_rank(tmp_path, monkeypatch):
         resolve_rank(machines)
 
 
+@pytest.mark.quick
+def test_local_row_slice_single_process():
+    """One process owns the whole row range; blocks tile [0, n)."""
+    from lightgbm_tpu.distributed import local_row_slice
+    s = local_row_slice(1001)
+    assert (s.start, s.stop) == (0, 1001)
+
+
+@pytest.mark.quick
+def test_allgather_f64_bit_exact_single_process():
+    """allgather_f64's uint32-word transport must round-trip float64
+    BIT-EXACTLY — including values float32 cannot represent (subnormal
+    magnitudes, 1/3's full mantissa): the property that keeps
+    bin boundaries identical across hosts."""
+    from lightgbm_tpu.distributed import allgather_f64
+    vals = np.array([1e-300, 1.0 / 3.0, np.pi, -0.0, 3.4e38 * 2.0,
+                     np.nextafter(1.0, 2.0)], np.float64)
+    out = allgather_f64(vals)
+    assert out.dtype == np.float64
+    assert out.shape == (1,) + vals.shape
+    assert np.array_equal(out[0].view(np.uint64), vals.view(np.uint64))
+
+
+@pytest.mark.quick
+def test_find_bin_mappers_single_process_matches_direct():
+    """The distributed bin-finding path with world=1 must equal the
+    direct find_bin_mappers call (same sample, same seed)."""
+    from lightgbm_tpu.binning import find_bin_mappers
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.distributed import find_bin_mappers_distributed
+    rng = np.random.RandomState(3)
+    sample = rng.randn(500, 4)
+    cfg = Config()
+    got, sample_back = find_bin_mappers_distributed(sample, cfg,
+                                                    return_sample=True)
+    want = find_bin_mappers(sample, cfg.max_bin, cfg.min_data_in_bin,
+                            cfg.min_data_in_leaf, sample_cnt=len(sample),
+                            seed=cfg.data_random_seed)
+    assert np.array_equal(sample_back, sample)
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        assert np.array_equal(np.asarray(g.bin_upper_bound),
+                              np.asarray(w.bin_upper_bound))
+
+
+_COLLECTIVE_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, {root!r})
+    import numpy as np
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.distributed import (allgather_f64,
+                                          find_bin_mappers_distributed,
+                                          init_distributed,
+                                          local_row_slice)
+    assert init_distributed(num_machines=2, local_listen_port={port})
+    assert len(jax.devices()) == 8
+    rank = jax.process_index()
+
+    # 1. bit-exact f64 allgather: each rank contributes values float32
+    #    would corrupt; every rank must see both payloads unchanged
+    payload = np.array([1e-300 * (rank + 1), 1.0 / 3.0 + rank,
+                        np.pi * (rank + 1)], np.float64)
+    out = allgather_f64(payload)
+    assert out.shape == (2, 3)
+    for r in range(2):
+        want = np.array([1e-300 * (r + 1), 1.0 / 3.0 + r,
+                         np.pi * (r + 1)], np.float64)
+        assert np.array_equal(out[r].view(np.uint64),
+                              want.view(np.uint64)), (rank, r)
+
+    # 2. pre-partition row blocks tile the dataset
+    n = 3001
+    s = local_row_slice(n)
+    sizes = allgather_f64(np.array([s.stop - s.start], np.float64))
+    assert int(sizes.sum()) == n
+
+    # 3. distributed bin finding: identical mappers on every rank, and
+    #    equal to the single-process mappers over the concatenated
+    #    sample (every rank sees only its half)
+    rng = np.random.RandomState(11)
+    full = rng.randn(600, 3)
+    local = full[rank * 300:(rank + 1) * 300]
+    cfg = Config()
+    mappers, gsample = find_bin_mappers_distributed(local, cfg,
+                                                    return_sample=True)
+    assert np.array_equal(gsample, full), "global sample differs"
+    from lightgbm_tpu.binning import find_bin_mappers
+    want = find_bin_mappers(full, cfg.max_bin, cfg.min_data_in_bin,
+                            cfg.min_data_in_leaf, sample_cnt=len(full),
+                            seed=cfg.data_random_seed)
+    for g, w in zip(mappers, want):
+        assert np.array_equal(np.asarray(g.bin_upper_bound),
+                              np.asarray(w.bin_upper_bound))
+    print("COLLECTIVE_OK", rank)
+""")
+
+
+def test_two_process_collective_plumbing(tmp_path):
+    """distributed.py's collective layer under the 8-device world
+    (2 processes x 4 virtual devices): bit-exact allgather_f64,
+    row-block tiling, and rank-identical distributed bin mappers —
+    the plumbing the data-parallel learners stand on.  Self-skips on
+    jax builds whose CPU backend cannot run multiprocess computations
+    (the same limitation that blocks the other two-process tests)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "collective_worker.py"
+    script.write_text(_COLLECTIVE_WORKER.format(root=root, port=12443))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = []
+    for rank in (0, 1):
+        e = dict(env, LIGHTGBM_TPU_MACHINE_RANK=str(rank))
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=e,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        outs.append(out)
+    if any("Multiprocess computations aren't implemented" in o
+           for o in outs):
+        pytest.skip("this jax build has no multiprocess CPU backend")
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-2000:]
+    assert any("COLLECTIVE_OK 0" in o for o in outs)
+    assert any("COLLECTIVE_OK 1" in o for o in outs)
+
+
 _WORKER = textwrap.dedent("""
     import os, sys
     os.environ["JAX_PLATFORMS"] = "cpu"

@@ -13,13 +13,17 @@ import jax
 import jax.numpy as jnp
 
 from lightgbm_tpu.diagnostics.sanitize import (
-    HotPathSanitizer, transfer_guard_effective)
+    DivergenceSanitizer, HotPathSanitizer, transfer_guard_effective)
 
 pytestmark = pytest.mark.quick
 
 _GUARD_OK = transfer_guard_effective()
 needs_guard = pytest.mark.skipif(
     not _GUARD_OK, reason="jax.transfer_guard is a no-op on this backend")
+# the cross-shard divergence checks need >= 2 devices to compare
+needs_mesh = pytest.mark.skipif(
+    len(jax.devices()) < 2,
+    reason="divergence checks need >= 2 devices to compare replicas")
 
 
 # ---------------------------------------------------------------------------
@@ -140,6 +144,122 @@ def test_warmup_steps_run_unguarded():
         with san.step():                       # steady state: counted
             (x * 3.5).block_until_ready()
     assert san.implicit_transfers == 1
+
+
+# ---------------------------------------------------------------------------
+# cross-shard divergence sanitizer (the runtime half of shardlint)
+# ---------------------------------------------------------------------------
+
+
+def _mesh_and_smap():
+    from jax.sharding import Mesh, PartitionSpec as P
+    from lightgbm_tpu.learner.common import compat_shard_map
+    n = len(jax.devices())
+    mesh = Mesh(np.asarray(jax.devices()).reshape(n), ("data",))
+    return mesh, P, compat_shard_map
+
+
+@needs_mesh
+@pytest.mark.sanitize
+def test_divergence_clean_replicated_output():
+    """A genuinely replicated shard_map output (psum result) passes:
+    one check per leaf, zero divergences."""
+    mesh, P, smap = _mesh_and_smap()
+    f = jax.jit(smap(lambda x: jax.lax.psum(x, "data"), mesh=mesh,
+                     in_specs=P("data"), out_specs=P()))
+    out = f(jnp.arange(len(jax.devices()), dtype=jnp.float32))
+    san = DivergenceSanitizer(label="unit")
+    assert san.check("psum", {"v": out}) == 0
+    assert san.checks == 1 and san.divergences == 0
+    rep = san.report()
+    assert rep["divergence_checks"] == 1 and rep["divergences"] == 0
+
+
+@needs_mesh
+@pytest.mark.sanitize
+def test_divergence_detects_shard_local_leak():
+    """The true positive the static pass cannot close over: an
+    out_specs=P() result that actually varies per shard (an axis_index
+    leak under check_vma=False) — per-device fingerprints differ and
+    strict mode hard-fails naming the leaf."""
+    mesh, P, smap = _mesh_and_smap()
+    f = jax.jit(smap(
+        lambda x: (jnp.sum(x)
+                   + jax.lax.axis_index("data").astype(jnp.float32)
+                   ).reshape(1),
+        mesh=mesh, in_specs=P("data"), out_specs=P()))
+    bad = f(jnp.arange(len(jax.devices()), dtype=jnp.float32))
+    lax_san = DivergenceSanitizer(label="unit", strict=False)
+    assert lax_san.check("leak", {"tree": bad}) == 1
+    assert lax_san.divergences == 1
+    assert lax_san.evidence and lax_san.evidence[0][0] == "leak"
+    with pytest.raises(AssertionError, match="cross-shard divergence"):
+        DivergenceSanitizer(label="unit").check("leak", {"tree": bad})
+
+
+@needs_mesh
+@pytest.mark.sanitize
+def test_divergence_skips_genuinely_sharded_arrays():
+    """Row-sharded outputs (leaf_id etc.) are not replicated state and
+    must not count as checks — no false positives on legal sharding."""
+    mesh, P, smap = _mesh_and_smap()
+    f = jax.jit(smap(lambda x: x * 2.0, mesh=mesh, in_specs=P("data"),
+                     out_specs=P("data")))
+    sharded = f(jnp.arange(len(jax.devices()) * 4, dtype=jnp.float32))
+    san = DivergenceSanitizer(label="unit")
+    assert san.check("sharded", {"rows": sharded}) == 0
+    assert san.checks == 0
+
+
+@needs_mesh
+@pytest.mark.sanitize
+def test_divergence_hooks_fire_in_mesh_training(monkeypatch):
+    """BENCH_SANITIZE=1 turns on the learner hooks: a data-parallel
+    boosting loop fingerprints the replicated tree arrays every
+    iteration (divergence_checks grows, divergences stays 0) and the
+    counters land in the HotPathSanitizer report."""
+    monkeypatch.setenv("BENCH_SANITIZE", "1")
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(13)
+    X = rng.randn(3000, 8)
+    y = (X[:, 0] + 0.4 * X[:, 1] > 0).astype(np.float64)
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 15,
+              "min_data_in_leaf": 5, "tree_learner": "data",
+              "tree_growth": "rounds"}
+    ds = lgb.Dataset(X, y).construct(params)
+    bst = lgb.Booster(params, ds)
+    san = HotPathSanitizer(warmup=2, label="divergence-loop")
+    with san:
+        for _ in range(4):
+            with san.step():
+                bst.update()
+    san.check()
+    rep = san.report()
+    assert rep["divergence_checks"] > 0
+    assert rep["divergences"] == 0
+
+
+@needs_mesh
+@pytest.mark.sanitize
+def test_divergence_hooks_off_by_default(monkeypatch):
+    """Without BENCH_SANITIZE the hooks are a no-op — the hot path pays
+    one env read, no device fetches."""
+    monkeypatch.delenv("BENCH_SANITIZE", raising=False)
+    from lightgbm_tpu import profiling
+    from lightgbm_tpu.diagnostics import sanitize as S
+    base = profiling.counter_value(S.DIVERGENCE_CHECKS)
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(3)
+    X = rng.randn(1500, 6)
+    y = (X[:, 0] > 0).astype(np.float64)
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 7,
+              "min_data_in_leaf": 5, "tree_learner": "data",
+              "tree_growth": "rounds"}
+    ds = lgb.Dataset(X, y).construct(params)
+    bst = lgb.Booster(params, ds)
+    for _ in range(2):
+        bst.update()
+    assert profiling.counter_value(S.DIVERGENCE_CHECKS) == base
 
 
 # ---------------------------------------------------------------------------

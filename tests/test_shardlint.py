@@ -1,0 +1,502 @@
+"""shardlint rule-by-rule fixtures (lightgbm_tpu/diagnostics/lint.py,
+SPMD collective-correctness family): one true positive AND one true
+negative per rule — collective-mismatch, divergent-collective,
+scatter-divisibility, replication-leak — plus the stale-allowlist
+audit and the --json output of scripts/run_lint.py.
+
+These are SOURCE fixtures — the linter is pure AST, so nothing here is
+executed (no jax import cost in this module's tests)."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from lightgbm_tpu.diagnostics.lint import (lint_paths, lint_run,
+                                           stale_allowlist_entries)
+
+pytestmark = pytest.mark.quick
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# every fixture builds a mesh so the axis universe is {"data",
+# "feature"}, like the package's make_mesh
+MESH = """
+    import functools
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    def make_mesh():
+        devs = np.asarray(jax.devices())
+        return jax.sharding.Mesh(devs.reshape(4, 2), ("data", "feature"))
+"""
+
+
+def run_lint(tmp_path, src, allowlist=None):
+    p = tmp_path / "fixture_mod.py"
+    p.write_text(textwrap.dedent(MESH) + textwrap.dedent(src))
+    return lint_paths([str(p)], str(tmp_path), allowlist or {})
+
+
+def has(findings, rule, needle=""):
+    return any(f.rule == rule and needle in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# collective-mismatch
+# ---------------------------------------------------------------------------
+
+
+def test_mismatch_unknown_axis_literal(tmp_path):
+    fs = run_lint(tmp_path, """
+        def body(x):
+            return jax.lax.psum(x, "rows")      # no mesh has axis "rows"
+
+        def run(x, mesh):
+            return jax.shard_map(body, mesh=mesh, in_specs=P("data"),
+                                 out_specs=P("data"))(x)
+        """)
+    assert has(fs, "collective-mismatch", "'rows'")
+
+
+def test_mismatch_axis_param_binding(tmp_path):
+    """A bad axis name hidden behind a parameter binding
+    (functools.partial(builder, data_axis="rows")) is caught at the
+    binding site."""
+    fs = run_lint(tmp_path, """
+        def builder(x, data_axis=None):
+            if data_axis is not None:
+                x = jax.lax.psum(x, data_axis)
+            return x
+
+        def run(x, mesh):
+            fn = functools.partial(builder, data_axis="rows")
+            return jax.shard_map(fn, mesh=mesh, in_specs=P("data"),
+                                 out_specs=P("data"))(x)
+        """)
+    assert has(fs, "collective-mismatch", "data_axis='rows'")
+
+
+def test_mismatch_partition_spec_literal(tmp_path):
+    fs = run_lint(tmp_path, """
+        def run(x, mesh):
+            spec = P("batch")                   # no mesh axis "batch"
+            return jax.device_put(x, jax.sharding.NamedSharding(mesh, spec))
+        """)
+    assert has(fs, "collective-mismatch", "PartitionSpec")
+
+
+def test_mismatch_collective_outside_shard_map(tmp_path):
+    """A literal-axis collective in jitted code with no enclosing
+    shard_map traces with an unbound axis."""
+    fs = run_lint(tmp_path, """
+        @jax.jit
+        def lonely(x):
+            return jax.lax.psum(x, "data")
+        """)
+    assert has(fs, "collective-mismatch", "not reachable from any shard_map")
+
+
+def test_mismatch_axes_from_make_mesh(tmp_path):
+    """The modern jax.make_mesh(axis_shapes, axis_names) constructor
+    feeds the axis universe too — a tree built only with it must not
+    silently disable the axis-name checks."""
+    src = textwrap.dedent("""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        def make():
+            return jax.make_mesh((4, 2), ("data", "feature"))
+
+        def good(x):
+            return jax.lax.psum(x, "data")
+
+        def bad(x):
+            return jax.lax.psum(x, "rows")
+
+        def run(x, mesh):
+            g = jax.shard_map(good, mesh=mesh, in_specs=P("data"),
+                              out_specs=P("data"))
+            b = jax.shard_map(bad, mesh=mesh, in_specs=P("data"),
+                              out_specs=P("data"))
+            return g(x), b(x)
+        """)
+    p = tmp_path / "fixture_mod.py"
+    p.write_text(src)
+    fs = lint_paths([str(p)], str(tmp_path), {})
+    assert has(fs, "collective-mismatch", "'rows'")
+    assert not any(f.qualname == "good" for f in fs), \
+        [f.render() for f in fs]
+
+
+def test_mismatch_true_negatives(tmp_path):
+    fs = run_lint(tmp_path, """
+        def body(x):
+            h = jax.lax.psum(x, "data")         # valid mesh axis
+            i = jax.lax.axis_index("data")
+            return h + i
+
+        def builder(x, data_axis=None):
+            # None-guarded axis parameter: legal jitted standalone
+            return jax.lax.psum(x, data_axis) if data_axis is not None else x
+
+        def run(x, mesh):
+            fn = functools.partial(builder, data_axis="data")
+            sharded = jax.shard_map(body, mesh=mesh, in_specs=P("data"),
+                                    out_specs=P("data"))
+            spec = P(None, "data")
+            return sharded(x), fn, spec
+        """)
+    assert not any(f.rule == "collective-mismatch" for f in fs), \
+        [f.render() for f in fs]
+
+
+# ---------------------------------------------------------------------------
+# divergent-collective
+# ---------------------------------------------------------------------------
+
+
+def test_divergent_collective_one_branch(tmp_path):
+    fs = run_lint(tmp_path, """
+        def with_coll(x):
+            return jax.lax.psum(x, "data")
+
+        def without(x):
+            return x
+
+        def body(x, flag):
+            return jax.lax.cond(flag, with_coll, without, x)
+
+        def run(x, f, mesh):
+            return jax.shard_map(body, mesh=mesh,
+                                 in_specs=(P("data"), P()),
+                                 out_specs=P("data"))(x, f)
+        """)
+    assert has(fs, "divergent-collective", "only one branch")
+
+
+def test_divergent_collective_shard_local_predicate(tmp_path):
+    fs = run_lint(tmp_path, """
+        def with_coll(x):
+            return jax.lax.psum(x, "data")
+
+        def body(x):
+            mine = jax.lax.axis_index("data")
+            return jax.lax.cond(mine > 0, with_coll, with_coll, x)
+
+        def run(x, mesh):
+            return jax.shard_map(body, mesh=mesh, in_specs=P("data"),
+                                 out_specs=P("data"))(x)
+        """)
+    assert has(fs, "divergent-collective", "shard-local predicate")
+
+
+def test_divergent_collective_true_negatives(tmp_path):
+    fs = run_lint(tmp_path, """
+        def with_coll(x):
+            return jax.lax.psum(x, "data")
+
+        def also_coll(x):
+            return jax.lax.psum(x * 2, "data")
+
+        def plain_a(x):
+            return x
+
+        def plain_b(x):
+            return -x
+
+        def body(x, flag):
+            # both branches reduce: every shard reaches a collective
+            y = jax.lax.cond(flag, with_coll, also_coll, x)
+            # replicated predicate: psum-derived, provably identical
+            total = jax.lax.psum(x, "data")
+            z = jax.lax.cond(jnp.sum(total) > 0, with_coll, plain_a, y)
+            # no collectives in either branch: predicate may diverge
+            return jax.lax.cond(flag, plain_a, plain_b, z)
+
+        def run(x, f, mesh):
+            return jax.shard_map(body, mesh=mesh,
+                                 in_specs=(P("data"), P()),
+                                 out_specs=P("data"))(x, f)
+        """)
+    assert not any(f.rule == "divergent-collective" for f in fs), \
+        [f.render() for f in fs]
+
+
+# ---------------------------------------------------------------------------
+# scatter-divisibility
+# ---------------------------------------------------------------------------
+
+
+def test_scatter_divisibility_unguarded(tmp_path):
+    fs = run_lint(tmp_path, """
+        def body(h):
+            return jax.lax.psum_scatter(h, "data", scatter_dimension=0,
+                                        tiled=True)
+
+        def run(h, mesh):
+            return jax.shard_map(body, mesh=mesh, in_specs=P(None),
+                                 out_specs=P("data"))(h)
+        """)
+    assert has(fs, "scatter-divisibility")
+
+
+def test_scatter_divisibility_guarded(tmp_path):
+    fs = run_lint(tmp_path, """
+        def guarded_assert(h, nd):
+            assert h.shape[0] % nd == 0, "store must tile the data axis"
+            return jax.lax.psum_scatter(h, "data", scatter_dimension=0,
+                                        tiled=True)
+
+        def guarded_raise(h, nd):
+            if h.shape[0] % nd:
+                raise ValueError("store columns must tile the data axis")
+            return jax.lax.psum_scatter(h, "data", scatter_dimension=0,
+                                        tiled=True)
+
+        def guarded_pad(h, nd):
+            k2 = h.shape[0]
+            k2p = nd * ((k2 + nd - 1) // nd)    # pad-to-multiple idiom
+            hp = jnp.concatenate([h, jnp.zeros((k2p - k2,) + h.shape[1:])])
+            return jax.lax.psum_scatter(hp, "data", scatter_dimension=0,
+                                        tiled=True)
+
+        def run(h, mesh):
+            fns = [functools.partial(g, nd=4)
+                   for g in (guarded_assert, guarded_raise, guarded_pad)]
+            return [jax.shard_map(f, mesh=mesh, in_specs=P(None),
+                                  out_specs=P("data"))(h) for f in fns]
+        """)
+    assert not any(f.rule == "scatter-divisibility" for f in fs), \
+        [f.render() for f in fs]
+
+
+def test_scatter_divisibility_guard_in_enclosing_function(tmp_path):
+    """The learners' shape: the guard lives in the builder, the
+    psum_scatter in a nested closure."""
+    fs = run_lint(tmp_path, """
+        def build(bins, nd):
+            F = bins.shape[0]
+            if F % nd:
+                raise ValueError("store columns must tile the data axis")
+
+            def exchange(h):
+                return jax.lax.psum_scatter(h, "data",
+                                            scatter_dimension=0, tiled=True)
+
+            return exchange(bins)
+
+        def run(bins, mesh):
+            fn = functools.partial(build, nd=4)
+            return jax.shard_map(fn, mesh=mesh, in_specs=P(None),
+                                 out_specs=P("data"))(bins)
+        """)
+    assert not any(f.rule == "scatter-divisibility" for f in fs), \
+        [f.render() for f in fs]
+
+
+# ---------------------------------------------------------------------------
+# replication-leak
+# ---------------------------------------------------------------------------
+
+
+def test_replication_leak_cond_predicate(tmp_path):
+    fs = run_lint(tmp_path, """
+        def body(x):
+            mine = jax.lax.axis_index("data")
+            local = jnp.sum(x) * mine           # shard-local derivation
+            return jax.lax.cond(local > 0, lambda v: v, lambda v: -v, x)
+
+        def run(x, mesh):
+            return jax.shard_map(body, mesh=mesh, in_specs=P("data"),
+                                 out_specs=P("data"))(x)
+        """)
+    assert has(fs, "replication-leak", "predicate")
+    # the lambdas hold no collectives, so this is NOT also flagged as a
+    # divergent collective — the rules separate the two failure shapes
+    assert not any(f.rule == "divergent-collective" for f in fs)
+
+
+def test_replication_leak_fori_bound(tmp_path):
+    fs = run_lint(tmp_path, """
+        def body(x):
+            slice_ = jax.lax.psum_scatter(x, "data", scatter_dimension=0,
+                                          tiled=True)  # shard-local result
+            n = jnp.sum(slice_).astype(jnp.int32)
+            if x.shape[0] % 4:
+                raise ValueError("pad first")
+            return jax.lax.fori_loop(0, n, lambda i, c: c + 1.0, 0.0)
+
+        def run(x, mesh):
+            return jax.shard_map(body, mesh=mesh, in_specs=P(None),
+                                 out_specs=P("data"))(x)
+        """)
+    assert has(fs, "replication-leak", "fori_loop bound")
+
+
+def test_replication_leak_true_negatives(tmp_path):
+    fs = run_lint(tmp_path, """
+        def combine_sharded_records(recs, axis_name):
+            allr = jax.lax.all_gather(recs, axis_name)
+            return allr[jnp.argmax(allr[:, 0])]
+
+        def body(x):
+            mine = jax.lax.axis_index("data")
+            local = jnp.sum(x) * mine
+            # replicating collective clears the taint
+            total = jax.lax.psum(local, "data")
+            a = jax.lax.cond(total > 0, lambda v: v, lambda v: -v, x)
+            # combine_sharded_records output is replicated by contract
+            rec = combine_sharded_records(jnp.stack([local, local]), "data")
+            b = jax.lax.cond(rec[0] > 0, lambda v: v, lambda v: -v, a)
+            # unknown-provenance predicates (parameters) do not flag:
+            # the runtime DivergenceSanitizer owns that remainder
+            return jax.lax.fori_loop(0, x.shape[0], lambda i, c: c + b, b)
+
+        def run(x, mesh):
+            return jax.shard_map(body, mesh=mesh, in_specs=P("data"),
+                                 out_specs=P())(x)
+        """)
+    assert not any(f.rule == "replication-leak" for f in fs), \
+        [f.render() for f in fs]
+
+
+def test_rules_reach_through_partial_and_lax_bodies(tmp_path):
+    """Traced-region discovery carries shardlint too: a collective with
+    a bad axis inside a lax.fori_loop body handed out via
+    functools.partial is still found."""
+    fs = run_lint(tmp_path, """
+        def loop_body(i, c, scale):
+            return c + jax.lax.psum(c * scale, "rows")   # bad axis
+
+        def body(x):
+            fn = functools.partial(loop_body, scale=2.0)
+            return jax.lax.fori_loop(0, 3, fn, x)
+
+        def run(x, mesh):
+            return jax.shard_map(body, mesh=mesh, in_specs=P("data"),
+                                 out_specs=P("data"))(x)
+        """)
+    assert has(fs, "collective-mismatch", "'rows'")
+
+
+def test_suppression_applies_to_shardlint_rules(tmp_path):
+    fs = run_lint(tmp_path, """
+        def with_coll(x):
+            return jax.lax.psum(x, "data")
+
+        def without(x):
+            return x
+
+        def body(x, flag):
+            # graftlint: allow(divergent-collective) — flag is replicated by construction in this fixture
+            return jax.lax.cond(flag, with_coll, without, x)
+
+        def run(x, f, mesh):
+            return jax.shard_map(body, mesh=mesh,
+                                 in_specs=(P("data"), P()),
+                                 out_specs=P("data"))(x, f)
+        """)
+    assert not any(f.rule == "divergent-collective" for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# stale allowlist + --json CLI
+# ---------------------------------------------------------------------------
+
+
+def test_stale_allowlist_entries_api(tmp_path):
+    src = """
+        @jax.jit
+        def listed(x):
+            return float(jnp.sum(x))
+        """
+    p = tmp_path / "fixture_mod.py"
+    p.write_text(textwrap.dedent(MESH) + textwrap.dedent(src))
+    allow = {
+        ("fixture_mod.py", "host-sync", "listed"): "reviewed reason",
+        ("fixture_mod.py", "host-sync", "renamed_away"): "stale entry",
+        ("gone_mod.py", "host-sync", "f"): "file deleted",
+    }
+    findings, stale = lint_run([str(p)], str(tmp_path), allow)
+    assert not any(f.rule == "host-sync" for f in findings)
+    assert len(stale) == 2
+    assert any("renamed_away" in s and "no longer produces" in s
+               for s in stale)
+    assert any("gone_mod.py" in s and "no longer exists" in s
+               for s in stale)
+
+
+def test_stale_allowlist_fails_run_lint(tmp_path):
+    """scripts/run_lint.py exits nonzero on a stale entry, exactly like
+    check_config_coverage.py does for stale config allowlist keys."""
+    allow = tmp_path / "allow.txt"
+    real = open(os.path.join(ROOT, "scripts", "lint_allowlist.txt")).read()
+    allow.write_text(real + "\nlightgbm_tpu/engine.py::host-sync::"
+                     "no_such_function — bogus entry\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "run_lint.py"),
+         "--allowlist", str(allow)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode != 0, r.stdout
+    assert "stale allowlist entry" in r.stdout
+    assert "no_such_function" in r.stdout
+
+
+def test_stale_audit_skipped_on_partial_path_runs():
+    """A single-file lint run must NOT flag allowlist entries as stale:
+    whether an entry still produces its finding depends on whole-package
+    context (log.py's retrace-hazard fires only when ops/histogram.py is
+    in scope to mark log.warning traced-reachable)."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "run_lint.py"),
+         os.path.join(ROOT, "lightgbm_tpu", "log.py")],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "stale" not in r.stdout
+
+
+def test_run_lint_json_clean_package():
+    """The acceptance gate: the package is clean under the full rule
+    set, and --json emits the machine-readable shape with the summary
+    on stderr."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "run_lint.py"),
+         "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    out = json.loads(r.stdout)
+    assert out["ok"] is True
+    assert out["findings"] == []
+    assert out["stale_allowlist"] == []
+    assert "graftlint OK" in r.stderr
+
+
+def test_run_lint_json_findings_shape(tmp_path):
+    p = tmp_path / "fixture_mod.py"
+    p.write_text(textwrap.dedent(MESH) + textwrap.dedent("""
+        def body(x):
+            return jax.lax.psum(x, "rows")
+
+        def run(x, mesh):
+            return jax.shard_map(body, mesh=mesh, in_specs=P("data"),
+                                 out_specs=P("data"))(x)
+        """))
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "run_lint.py"),
+         "--json", str(p)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1
+    out = json.loads(r.stdout)
+    assert out["ok"] is False
+    f = next(f for f in out["findings"]
+             if f["rule"] == "collective-mismatch")
+    assert set(f) == {"file", "line", "rule", "qualname", "message"}
+    assert f["qualname"] == "body"
+    assert isinstance(f["line"], int) and f["line"] > 0
+    assert "graftlint: " in r.stderr
