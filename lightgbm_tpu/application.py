@@ -134,7 +134,8 @@ class Application:
         bst = Booster(model_file=cfg.input_model)
         predictor = Predictor(bst, raw_score=cfg.is_predict_raw_score,
                               leaf_index=cfg.is_predict_leaf_index,
-                              num_iteration=cfg.num_iteration_predict)
+                              num_iteration=cfg.num_iteration_predict,
+                              predict_kernel=cfg.predict_kernel)
         predictor.predict_file(cfg.data, cfg.output_result,
                                has_header=cfg.has_header,
                                label_idx=_label_idx(cfg))
@@ -159,7 +160,7 @@ class Predictor:
 
     def __init__(self, booster: Booster, raw_score: bool = False,
                  leaf_index: bool = False, num_iteration: int = -1,
-                 runtime=None):
+                 runtime=None, predict_kernel=None):
         self.booster = booster
         self.raw_score = raw_score
         self.leaf_index = leaf_index
@@ -171,7 +172,8 @@ class Predictor:
             # returns the baseline score, nothing to compile
             from .serving.runtime import PredictorRuntime
             runtime = PredictorRuntime(booster, num_iteration=num_iteration,
-                                       max_batch_rows=262_144)
+                                       max_batch_rows=262_144,
+                                       predict_kernel=predict_kernel)
         self.runtime = runtime
 
     def predict(self, X: np.ndarray) -> np.ndarray:

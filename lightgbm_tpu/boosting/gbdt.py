@@ -77,10 +77,14 @@ class GBDT:
             feat_tbl=train_set.bundle_feat_table())
         # continued training (input_model): replay the loaded model onto
         # the fresh training scores (the reference re-scores via a
-        # Predictor closure during loading, application.cpp:106-113)
-        for i, t in enumerate(self.models):
+        # Predictor closure during loading, application.cpp:106-113) —
+        # one tensorized binned traversal for the whole model under
+        # predict_kernel=tensorized (score_updater.add_trees)
+        for t in self.models:
             t.rebin_to_dataset(train_set)
-            self.train_score.add_tree(t, i % self.K)
+        if self.models:
+            self.train_score.add_trees(self.models, self.K,
+                                       cfg.predict_kernel)
         self.feature_names = list(train_set.feature_names)
         self.feature_infos = train_set.feature_infos()
         self.max_feature_idx = train_set.num_total_features - 1
@@ -131,10 +135,12 @@ class GBDT:
                 m.init(valid_set.metadata, valid_set.num_data)
                 ms.append(m)
         # replay existing model onto the new valid scores (loaded trees
-        # first need in-bin thresholds for this dataset's mappers)
-        for i, t in enumerate(self.models):
+        # first need in-bin thresholds for this dataset's mappers); the
+        # tensorized kernel replays the whole model in `depth` passes
+        for t in self.models:
             t.rebin_to_dataset(valid_set)
-            su.add_tree(t, i % self.K)
+        if self.models:
+            su.add_trees(self.models, self.K, cfg.predict_kernel)
         self.valid_sets.append((name, valid_set, su, ms))
 
     # ------------------------------------------------------------------
@@ -454,18 +460,51 @@ class GBDT:
     # stacked device walk (ops/predict.py); small calls keep the host f64
     # walk (no jit latency, reference-exact double comparisons)
     _DEVICE_PREDICT_MIN_WORK = 2_000_000
+    _PREDICT_CHUNK = 262_144
+
+    def _cache_predict_stack(self, key, value):
+        """Bounded-size put: the stack cache never outgrows a few model
+        generations (stale generations evict wholesale)."""
+        if len(self._predict_stack_cache) >= 4 * max(self.K, 1):
+            self._predict_stack_cache.clear()
+        self._predict_stack_cache[key] = value
+        return value
+
+    def _run_chunked(self, X: np.ndarray, out: np.ndarray, kernel_fn):
+        """Shared device-predict chunk loop: full `_PREDICT_CHUNK` slabs
+        plus ONE padded remainder, so the jitted kernel only ever sees
+        one compiled shape.  `kernel_fn` maps a [chunk, F] f32 slab to
+        device values whose LAST axis is rows; rows land in
+        ``out[..., a:b]``."""
+        import jax.numpy as jnp
+        n = X.shape[0]
+        CHUNK = self._PREDICT_CHUNK
+        for a in range(0, n, CHUNK):
+            b = min(a + CHUNK, n)
+            chunk = X[a:b]
+            if b - a < CHUNK and n > CHUNK:
+                chunk = np.pad(chunk, ((0, CHUNK - (b - a)), (0, 0)))
+            vals = kernel_fn(jnp.asarray(chunk, jnp.float32))
+            out[..., a:b] = jax.device_get(vals)[..., : b - a]
 
     def _predict_raw_device(self, X: np.ndarray, used: int) -> np.ndarray:
         """Stacked-ensemble device predictor (predictor.hpp:24-159 is the
         reference's parallel batch path; here all trees × all rows advance
         one level per step on device).  f32 feature/threshold compares —
         the same single-precision trade the reference GPU learner makes
-        (docs/GPU-Performance.md:130-134)."""
-        from ..ops.predict import stack_trees, predict_trees
-        import jax.numpy as jnp
+        (docs/GPU-Performance.md:130-134).
+
+        ``predict_kernel=tensorized`` (the `auto` resolution) traverses
+        ALL classes' trees in one fused program; ``walk`` keeps the
+        per-class vmapped walk.
+        """
+        from ..ops.predict import (stack_trees, predict_trees,
+                                   resolve_predict_kernel)
+        kernel = resolve_predict_kernel(self.config.predict_kernel)
+        if kernel == "tensorized":
+            return self._predict_raw_device_tensorized(X, used)
         n = X.shape[0]
         out = np.zeros((self.K, n), np.float64)
-        CHUNK = 262_144
         for k in range(self.K):
             key = (used, k, len(self.models))
             cached = self._predict_stack_cache.get(key)
@@ -476,21 +515,35 @@ class GBDT:
                     continue
                 stack = stack_trees(trees, binned=False)
                 depth = max((t.max_depth_grown for t in trees), default=1)
-                cached = (stack, max(depth, 1))
-                if len(self._predict_stack_cache) >= 4 * max(self.K, 1):
-                    self._predict_stack_cache.clear()
-                self._predict_stack_cache[key] = cached
+                cached = self._cache_predict_stack(
+                    key, (stack, max(depth, 1)))
             stack, depth = cached
-            for a in range(0, n, CHUNK):
-                b = min(a + CHUNK, n)
-                chunk = X[a:b]
-                pad = 0
-                if b - a < CHUNK and n > CHUNK:
-                    pad = CHUNK - (b - a)   # keep one compiled shape
-                    chunk = np.pad(chunk, ((0, pad), (0, 0)))
-                vals = predict_trees(stack, jnp.asarray(chunk, jnp.float32),
-                                     depth=depth)
-                out[k, a:b] = jax.device_get(vals)[: b - a]
+            self._run_chunked(
+                X, out[k],
+                lambda c, _s=stack, _d=depth: predict_trees(_s, c, depth=_d))
+        return out[0] if self.K == 1 else out.T
+
+    def _predict_raw_device_tensorized(self, X: np.ndarray,
+                                       used: int) -> np.ndarray:
+        """One ensemble-wide traversal program for all classes (ops/
+        predict.py predict_ensemble_any): `depth` fused steps instead of
+        one walk per class."""
+        from ..ops.predict import build_ensemble, predict_ensemble_any
+        n = X.shape[0]
+        key = ("ens", used, len(self.models))
+        cached = self._predict_stack_cache.get(key)
+        if cached is None:
+            trees_by_class = [
+                [self.models[i] for i in range(used) if i % self.K == k]
+                for k in range(self.K)]
+            stack, meta = build_ensemble(trees_by_class, binned=False)
+            cached = self._cache_predict_stack(
+                key, (jax.device_put(stack), meta))
+        stack, meta = cached
+        out = np.zeros((self.K, n), np.float64)
+        self._run_chunked(
+            X, out,
+            lambda c: predict_ensemble_any(stack, c, meta=meta))
         return out[0] if self.K == 1 else out.T
 
     def predict_raw(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:

@@ -139,6 +139,12 @@ def shrink_clip_leaves(leaf_value: jax.Array, num_leaves: jax.Array,
 
 
 @jax.jit
+def _add_raw(score, raw):
+    """score += raw, one program (whole-model replay — add_trees)."""
+    return score + raw
+
+
+@jax.jit
 def _add_from_leaf(score_row, leaf_idx, leaf_values):
     # one-hot matmul, not table gather: XLA's [N] gather from a leaf-sized
     # table runs at <1 GB/s on TPU (see ops/lookup.py) and cost ~65 ms per
@@ -223,6 +229,32 @@ class ScoreUpdater:
             * np.float32(scale))
         self.score = _add_leaf_to_row(self.score, leaf_idx, lv,
                                       tree_id=tree_id)
+
+    def add_trees(self, trees, K: int, kernel: str = "auto") -> None:
+        """Replay a WHOLE model onto the scores (add_valid / continued-
+        training replay).  With ``predict_kernel=tensorized`` the replay
+        is ONE binned ensemble traversal — `depth` fused gather/select
+        passes over the store with integer bin compares (ops/predict.py
+        predict_ensemble_binned, EFB packed-slot remap included) —
+        instead of ``len(trees)`` sequential per-tree walk programs.
+        Stump constants ride in the stack (leaf 0), so the result matches
+        the sequential add_tree/add_constant loop to f32 addition
+        reassociation (exact on dyadic leaf values)."""
+        from ..ops.predict import (build_ensemble, predict_ensemble_binned,
+                                   resolve_predict_kernel)
+        if (resolve_predict_kernel(kernel) != "tensorized"
+                or len(trees) < 2 or self.bins_t is None):
+            for i, t in enumerate(trees):
+                self.add_tree(t, i % K)
+            return
+        trees_by_class = [[t for i, t in enumerate(trees) if i % K == k]
+                          for k in range(K)]
+        stack, meta = build_ensemble(trees_by_class, binned=True,
+                                     layout="soa")
+        stack = jax.device_put(stack)
+        raw = predict_ensemble_binned(stack, self.bins_t, self.feat_tbl,
+                                      meta=meta)                # [K, N]
+        self.score = _add_raw(self.score, raw)
 
     def add_tree_arrays_dev(self, arrs, leaf_values: jax.Array,
                             tree_id: int) -> None:

@@ -13,6 +13,11 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, List, Optional, Tuple
 
+# the predict_kernel dial's legal values — defined here (stdlib-only
+# module) so config validation and ops/predict.resolve_predict_kernel
+# check against ONE tuple and can't drift
+PREDICT_KERNELS = ("auto", "tensorized", "walk")
+
 # Alias table: parity with reference config.h:342-436 (ParameterAlias).
 PARAM_ALIASES: Dict[str, str] = {
     "config": "config_file",
@@ -105,6 +110,12 @@ PARAM_ALIASES: Dict[str, str] = {
     "serve_flush_deadline_ms": "flush_deadline_ms",
     "model_poll": "model_poll_seconds",
     "poll_seconds": "model_poll_seconds",
+    "serving_replicas": "serve_replicas",
+    "num_replicas": "serve_replicas",
+    "serve_max_pending_rows": "max_pending_rows",
+    "pending_rows_cap": "max_pending_rows",
+    "prediction_kernel": "predict_kernel",
+    "predict_engine": "predict_kernel",
     # exclusive feature bundling (EFB)
     "efb": "enable_bundle",
     "bundle": "enable_bundle",
@@ -311,6 +322,13 @@ class Config:
 
     # prediction
     num_iteration_predict: int = -1
+    # ensemble-traversal kernel for device prediction (ops/predict.py):
+    # "walk" = per-class vmapped tree walk (the original shape);
+    # "tensorized" = every tree of every class in ONE padded SoA, all
+    # rows x all trees advance one depth level per step (the Booster
+    # accelerator layout, arXiv:2011.02022) — also used for whole-model
+    # replay onto validation scores.  "auto" = tensorized.
+    predict_kernel: str = "auto"
 
     # -- online serving (task=serve, lightgbm_tpu/serving/)
     serve_host: str = "127.0.0.1"
@@ -319,6 +337,16 @@ class Config:
     flush_deadline_ms: float = 5.0    # max wait before a partial flush
     model_poll_seconds: float = 10.0  # hot-swap mtime poll (0 = off)
     min_bucket_rows: int = 16         # smallest padded row bucket
+    # serving fleet size: replicate compiled predictors across local
+    # devices with least-loaded dispatch.  0 = auto (every local device
+    # on accelerator backends, 1 on the CPU tier); N caps at the local
+    # device count.
+    serve_replicas: int = 0
+    # admission control: once this many rows are queued, further
+    # requests shed load with HTTP 503 instead of growing an unbounded
+    # queue (high-water mark — a single over-cap request on an idle
+    # server still admits).  0 = unbounded.
+    max_pending_rows: int = 0
 
     # fields that are parsed but unused on TPU (accepted for compat)
     config_file: str = ""
@@ -438,6 +466,12 @@ def check_param_conflict(cfg: Config) -> None:
         raise ValueError("flush_deadline_ms must be >= 0")
     if cfg.model_poll_seconds < 0:
         raise ValueError("model_poll_seconds must be >= 0")
+    if cfg.serve_replicas < 0:
+        raise ValueError("serve_replicas must be >= 0 (0 = auto)")
+    if cfg.max_pending_rows < 0:
+        raise ValueError("max_pending_rows must be >= 0 (0 = unbounded)")
+    if cfg.predict_kernel not in PREDICT_KERNELS:
+        raise ValueError(f"unknown predict_kernel: {cfg.predict_kernel}")
     if not (0.0 <= cfg.max_conflict_rate < 1.0):
         raise ValueError("max_conflict_rate must be in [0, 1)")
 

@@ -5,15 +5,50 @@ The reference predicts row-by-row with a pointer-chasing node walk
 TPU that becomes a vectorized breadth-parallel walk: all rows advance one
 level per step (`lax.fori_loop` over the tree depth), with gathers instead
 of pointer dereferences, vmapped over the stacked trees of the ensemble.
+
+Two kernels implement that walk, selected by ``predict_kernel``:
+
+- ``walk`` — the original shape: one `_walk_one_tree` per tree, vmapped
+  over each class's TreeStack, one program per class
+  (`predict_trees` / `ensemble_raw`).
+- ``tensorized`` — the Booster-accelerator shape (arXiv:2011.02022):
+  EVERY tree of EVERY class flattened into ONE padded ``[T, nodes]``
+  SoA whose per-node record (feature, threshold, decision, children,
+  default-left) is packed into a single trailing lane axis, so each
+  depth level costs ONE batched record gather + ONE feature gather +
+  selects for all N rows x T trees at once — `depth` loop iterations
+  total for the whole ensemble, and per-class sums fall out of one
+  sorted segment-sum.  A binned-input variant
+  (`predict_ensemble_binned`) walks the int bin store directly with
+  in-bin thresholds (integer compares, no float thresholding), including
+  the EFB packed-slot remap, so whole-model replay onto a ScoreUpdater
+  is `depth` passes instead of `len(trees)` sequential tree walks.
 """
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ..config import PREDICT_KERNELS
+
+
+def resolve_predict_kernel(kernel: str = "auto") -> str:
+    """Resolve the ``predict_kernel`` dial to a concrete kernel.
+
+    ``auto`` picks ``tensorized``: it traverses the whole ensemble in
+    `depth` fused steps on every backend, strictly fewer dispatches and
+    gathers than the per-class walk (which it matches bitwise on fp32
+    dyadic leaf values — tests/test_predict_kernel.py).  ``walk`` stays
+    reachable as the A/B baseline and conservative fallback.
+    """
+    if kernel not in PREDICT_KERNELS:
+        raise ValueError(f"unknown predict_kernel: {kernel!r}; "
+                         f"use one of {PREDICT_KERNELS}")
+    return "tensorized" if kernel == "auto" else kernel
 
 
 class TreeStack(NamedTuple):
@@ -120,3 +155,379 @@ def ensemble_raw(stacks, X: jax.Array, *, depths) -> jax.Array:
 
         outs.append(jnp.sum(jax.vmap(one_tree)(*stack), axis=0))
     return jnp.stack(outs)
+
+
+# ----------------------------------------------------------------------
+# tensorized ensemble traversal (predict_kernel=tensorized)
+# ----------------------------------------------------------------------
+
+# packed node-record lane order of EnsembleStack.nodes (one trailing lane
+# axis so each depth level fetches ALL per-node fields with ONE gather of
+# a contiguous record, instead of five scattered gathers):
+#   raw stacks    (f32): feat, threshold, is_cat, left, right, default_left
+#   binned stacks (i32): feat, threshold_bin, decision, left, right, 0
+# child ids / feature ids are exact in f32 (|v| < 2^24, num_leaves caps
+# far below that), so the raw record can stay one dtype.
+_LANES = 6
+
+
+class EnsembleStack(NamedTuple):
+    """Every tree of every class as ONE padded [T, nodes] SoA.
+
+    Trees are flattened class-major (class 0's trees in boosting order,
+    then class 1's, ...), so ``class_id`` is sorted ascending and the
+    per-class reduction is a sorted segment-sum.
+    """
+    nodes: jax.Array       # [T, M-1, _LANES] packed node records
+    leaf_value: jax.Array  # [T, M] f32
+    root: jax.Array        # [T] int32 — 0, or -1 for stumps (leaf 0)
+    class_id: jax.Array    # [T] int32, sorted ascending
+
+
+class PerfectEnsemble(NamedTuple):
+    """Shallow numerical ensembles re-laid out as PERFECT binary trees of
+    the ensemble depth: navigation is pure arithmetic (``2*node + 1 +
+    go_right``), so the walk needs NO child gathers and no parked-row
+    select — the Booster accelerator layout (arXiv:2011.02022 §3).
+
+    A leaf grown at depth d < D acts as a filler subtree: every
+    last-level record it covers carries the leaf's value in BOTH value
+    lanes, so the routing through filler slots is irrelevant (any path
+    lands on the same value).  The LAST level's records fuse the two
+    child leaf values in, saving the separate leaf-value gather.
+    """
+    inner: jax.Array       # [T, 2^(D-1)-1, 2] f32: (feature, threshold)
+    last: jax.Array        # [T, 2^(D-1), 4] f32: (feat, thr, lval, rval)
+    class_id: jax.Array    # [T] int32, sorted ascending
+
+
+class EnsembleMeta(NamedTuple):
+    """Static (hashable) companions of an ensemble stack — jit cache keys."""
+    depth: int             # levels to walk (max grown depth, >= 1)
+    num_class: int         # K — rows of the [K, N] output
+    any_cat: bool          # ensemble has categorical splits
+    any_default_left: bool  # any node routes NaN left (raw stacks only)
+
+
+# perfect relayout budget: total value-slab slots (T * 2^depth) above
+# which the padded-SoA traversal takes over — 2^22 slots is ~50 MB of
+# node records at the default, far above the north-star 500-tree
+# depth-8 shape (128k slots) and far below a pathological leaf-wise
+# chain (depth 30+ would want 2^31 slots per tree).
+PERFECT_SLOT_BUDGET = 1 << 22
+
+
+def _ensemble_shape(flat, binned: bool):
+    """(max-capacity leaves, walk depth, any_cat, any_dl) over a
+    class-major [(class, tree)] flatten — the ONE scan shared by
+    `build_ensemble`'s layout choice and `stack_ensemble`'s meta, so
+    the two can't desynchronize.  Binned stacks compare on
+    `binned_decision_type` (trivial-feature categorical splits rebin to
+    numerical sentinels) and never carry the NaN default-left lane
+    (binned replay routes missing rows by bin code)."""
+    m = max(max(t.max_leaves for _, t in flat), 2)
+    depth = 1
+    any_cat = False
+    any_dl = False
+    for _, t in flat:
+        if t.num_leaves < 2:
+            continue
+        depth = max(depth, t.max_depth_grown)
+        k = t.num_leaves - 1
+        dec = (getattr(t, "binned_decision_type", t.decision_type)
+               if binned else t.decision_type)
+        any_cat = any_cat or bool(np.any(dec[:k] == 1))
+        if not binned:
+            dl = getattr(t, "default_left", None)
+            any_dl = any_dl or (dl is not None and bool(np.any(dl[:k])))
+    return m, max(int(depth), 1), any_cat, any_dl
+
+
+def build_ensemble(trees_by_class, *, binned: bool = False,
+                   layout: str = "auto"):
+    """Build the tensorized-traversal stack for a whole model.
+
+    Returns ``(stack, meta)`` where stack is a PerfectEnsemble (shallow,
+    purely numerical, no default-left raw ensembles within
+    PERFECT_SLOT_BUDGET) or the general EnsembleStack SoA — both host
+    numpy pytrees; callers `jax.device_put` them (per replica for the
+    serving fleet).  `predict_ensemble_any` dispatches on the type.
+    """
+    num_class = len(trees_by_class)
+    flat = [(k, t) for k, trees in enumerate(trees_by_class) for t in trees]
+    if not flat:
+        raise ValueError("build_ensemble needs at least one tree")
+    shape = _ensemble_shape(flat, binned)
+    m, depth, any_cat, any_dl = shape
+    meta = EnsembleMeta(depth=depth, num_class=num_class, any_cat=any_cat,
+                        any_default_left=any_dl)
+    if layout not in ("auto", "perfect", "soa"):
+        raise ValueError(f"unknown ensemble layout: {layout!r}")
+    if layout == "auto":
+        fits = len(flat) << depth <= PERFECT_SLOT_BUDGET
+        layout = ("perfect" if fits and not binned and not any_cat
+                  and not any_dl else "soa")
+    if layout == "perfect":
+        if binned or any_cat or any_dl:
+            raise ValueError("perfect layout supports raw numerical "
+                             "no-default-left ensembles only")
+        return _build_perfect(flat, meta)
+    return stack_ensemble(trees_by_class, binned=binned, _shape=shape)
+
+
+def _build_perfect(flat, meta: EnsembleMeta
+                   ) -> tuple[PerfectEnsemble, EnsembleMeta]:
+    D = meta.depth
+    T = len(flat)
+    half = 1 << (D - 1)
+    inner = np.zeros((T, max(half - 1, 1), 2), np.float32)
+    last = np.zeros((T, half, 4), np.float32)
+    cls = np.zeros(T, np.int32)
+    for i, (k, t) in enumerate(flat):
+        cls[i] = k
+        if t.num_leaves < 2:                 # stump: one giant filler
+            last[i, :, 2] = last[i, :, 3] = np.float32(t.leaf_value[0])
+            continue
+        # iterative heap-order fill; a leaf met above the last level
+        # replicates its value across every last-level slot it covers
+        stack = [(0, 0, 0)]                  # (tree node, heap slot, level)
+        while stack:
+            node, slot, lvl = stack.pop()
+            if lvl == D - 1:                 # last level: fuse child values
+                local = slot - (half - 1)
+                if node < 0:                 # leaf: value in both lanes
+                    v = np.float32(t.leaf_value[~node])
+                    last[i, local, 2] = last[i, local, 3] = v
+                else:
+                    lc = int(t.left_child[node])
+                    rc = int(t.right_child[node])
+                    # children at depth D of a depth-D tree are leaves
+                    last[i, local, 0] = t.split_feature[node]
+                    last[i, local, 1] = np.float32(t.threshold[node])
+                    last[i, local, 2] = np.float32(t.leaf_value[~lc])
+                    last[i, local, 3] = np.float32(t.leaf_value[~rc])
+                continue
+            if node < 0:                     # early leaf: filler subtree
+                lo = (slot - ((1 << lvl) - 1)) << (D - 1 - lvl)
+                hi = lo + (1 << (D - 1 - lvl))
+                v = np.float32(t.leaf_value[~node])
+                last[i, lo:hi, 2] = last[i, lo:hi, 3] = v
+                continue
+            inner[i, slot, 0] = t.split_feature[node]
+            inner[i, slot, 1] = np.float32(t.threshold[node])
+            stack.append((int(t.left_child[node]), 2 * slot + 1, lvl + 1))
+            stack.append((int(t.right_child[node]), 2 * slot + 2, lvl + 1))
+    return PerfectEnsemble(inner=inner, last=last, class_id=cls), meta
+
+
+def stack_ensemble(trees_by_class, *, binned: bool, _shape=None
+                   ) -> tuple[EnsembleStack, EnsembleMeta]:
+    """Flatten per-class host Tree lists into one EnsembleStack (host
+    numpy — callers `jax.device_put` the pytree, per replica for the
+    serving fleet).  A class with no trees contributes no stack rows and
+    its output row stays zero (segment-sum over an absent segment),
+    matching `ensemble_raw`'s None handling.  Stumps ride along as
+    root=-1 rows whose leaf 0 carries the constant.
+    """
+    num_class = len(trees_by_class)
+    flat = [(k, t) for k, trees in enumerate(trees_by_class) for t in trees]
+    if not flat:
+        raise ValueError("stack_ensemble needs at least one tree")
+    m, depth, any_cat, any_dl = _shape or _ensemble_shape(flat, binned)
+    meta = EnsembleMeta(depth=depth, num_class=num_class, any_cat=any_cat,
+                        any_default_left=any_dl)
+    T = len(flat)
+    dtype = np.int32 if binned else np.float32
+    nodes = np.zeros((T, m - 1, _LANES), dtype)
+    lv = np.zeros((T, m), np.float32)
+    root = np.zeros(T, np.int32)
+    cls = np.zeros(T, np.int32)
+    for i, (k, t) in enumerate(flat):
+        n = t.num_leaves
+        cls[i] = k
+        lv[i, :n] = t.leaf_value[:n]
+        if n < 2:
+            root[i] = -1                     # stump: every row is leaf 0
+            continue
+        knodes = n - 1
+        if binned:
+            dec = getattr(t, "binned_decision_type", t.decision_type)
+            nodes[i, :knodes, 0] = t.split_feature_inner[:knodes]
+            nodes[i, :knodes, 1] = t.threshold_in_bin[:knodes]
+            nodes[i, :knodes, 2] = dec[:knodes]
+        else:
+            nodes[i, :knodes, 0] = t.split_feature[:knodes]
+            nodes[i, :knodes, 1] = t.threshold[:knodes].astype(np.float32)
+            nodes[i, :knodes, 2] = t.decision_type[:knodes]
+            dl = getattr(t, "default_left", None)
+            if dl is not None:
+                nodes[i, :knodes, 5] = np.asarray(dl[:knodes], dtype)
+        nodes[i, :knodes, 3] = t.left_child[:knodes]
+        nodes[i, :knodes, 4] = t.right_child[:knodes]
+    stack = EnsembleStack(nodes=nodes, leaf_value=lv, root=root,
+                          class_id=cls)
+    return stack, meta
+
+
+def _leaf_sums(stack: EnsembleStack, node: jax.Array, num_class: int
+               ) -> jax.Array:
+    """[K, N] per-class sums of the leaf values the [T, N] walk parked
+    on.  class_id is sorted (class-major flatten), so the segment-sum
+    reduces each class's trees in stack order — exact for fp32 dyadic
+    leaf values in any order, and the same trees the walk kernel sums."""
+    leaf = jnp.where(node < 0, ~node, 0)
+    vals = jnp.take_along_axis(stack.leaf_value, leaf, axis=1)   # [T, N]
+    if num_class == 1:
+        return jnp.sum(vals, axis=0)[None]
+    return jax.ops.segment_sum(vals, stack.class_id,
+                               num_segments=num_class,
+                               indices_are_sorted=True)
+
+
+@functools.partial(jax.jit, static_argnames=("meta",))
+def predict_ensemble(stack: EnsembleStack, X: jax.Array, *,
+                     meta: EnsembleMeta) -> jax.Array:
+    """Raw per-class scores over raw feature values — [K, N] f32.
+
+    All N rows x T trees advance one depth level per step: one batched
+    record gather, one feature gather, one select.  `meta.depth` loop
+    iterations total for the whole ensemble (the walk kernel runs a
+    depth-loop per class and five gathers per level).
+
+    Decision parity with `_walk_one_tree` is bitwise: numerical ``v <=
+    t`` (NaN falls right), categorical int-truncation compare.  Nodes
+    with the default-left lane set route NaN/non-finite values LEFT on
+    numerical splits (missing-value support; nothing sets it today, so
+    the select is compiled out unless the stack carries one).
+    """
+    Xf = X.astype(jnp.float32)
+    T = stack.nodes.shape[0]
+    N = Xf.shape[0]
+    rows = jnp.arange(N)[None, :]
+    node = jnp.broadcast_to(stack.root[:, None], (T, N))
+
+    def step(_, node):
+        safe = jnp.maximum(node, 0)
+        rec = jnp.take_along_axis(stack.nodes, safe[:, :, None], axis=1)
+        f = rec[..., 0].astype(jnp.int32)
+        v = Xf[rows, f]                                  # [T, N]
+        t = rec[..., 1]
+        gl = v <= t
+        if meta.any_default_left:
+            gl = jnp.where(jnp.isnan(v), rec[..., 5] > 0, gl)
+        if meta.any_cat:
+            # categorical: int truncation compare, matching the host
+            # walk (tree.py predict_leaf_index) and _walk_one_tree
+            gl = jnp.where(rec[..., 2] > 0,
+                           v.astype(jnp.int32) == t.astype(jnp.int32), gl)
+        nxt = jnp.where(gl, rec[..., 3], rec[..., 4]).astype(jnp.int32)
+        return jnp.where(node >= 0, nxt, node)
+
+    node = jax.lax.fori_loop(0, meta.depth, step, node)
+    return _leaf_sums(stack, node, meta.num_class)
+
+
+@functools.partial(jax.jit, static_argnames=("meta",))
+def predict_ensemble_perfect(stack: PerfectEnsemble, X: jax.Array, *,
+                             meta: EnsembleMeta) -> jax.Array:
+    """Raw per-class scores via perfect-layout traversal — [K, N] f32.
+
+    Per level: ONE 8-byte record gather + ONE feature gather + a
+    compare; the next node is arithmetic (no child gathers, no
+    parked-row select).  The root level is peeled into a broadcast
+    (every row reads record 0), and the last level's records carry both
+    child leaf values, so the separate leaf-value gather disappears.
+    Bitwise-identical routing to `_walk_one_tree` (same ``v <= t`` f32
+    compare on the same thresholds).
+    """
+    Xf = X.astype(jnp.float32)
+    T = stack.last.shape[0]
+    N = Xf.shape[0]
+    rows = jnp.arange(N)[None, :]
+    depth = meta.depth
+
+    def level(rec_slab, node):
+        r = jnp.take_along_axis(rec_slab, node[:, :, None], axis=1)
+        f = r[..., 0].astype(jnp.int32)
+        gl = Xf[rows, f] <= r[..., 1]
+        return r, gl
+
+    if depth == 1:
+        local = jnp.zeros((T, N), jnp.int32)
+    else:
+        # level 0: every row is at the root — broadcast, no gather
+        f0 = stack.inner[:, 0, 0].astype(jnp.int32)
+        gl0 = jnp.take(Xf, f0, axis=1).T <= stack.inner[:, 0, 1][:, None]
+        node = 2 - gl0.astype(jnp.int32)
+
+        def step(_, node):
+            _, gl = level(stack.inner, node)
+            return 2 * node + 2 - gl.astype(jnp.int32)
+
+        node = jax.lax.fori_loop(1, depth - 1, step, node)
+        local = node - ((1 << (depth - 1)) - 1)
+    r, gl = level(stack.last, local)
+    vals = jnp.where(gl, r[..., 2], r[..., 3])              # [T, N]
+    if meta.num_class == 1:
+        return jnp.sum(vals, axis=0)[None]
+    return jax.ops.segment_sum(vals, stack.class_id,
+                               num_segments=meta.num_class,
+                               indices_are_sorted=True)
+
+
+def predict_ensemble_any(stack, X: jax.Array, *,
+                         meta: EnsembleMeta) -> jax.Array:
+    """Layout dispatch (trace-time): PerfectEnsemble or EnsembleStack."""
+    if isinstance(stack, PerfectEnsemble):
+        return predict_ensemble_perfect(stack, X, meta=meta)
+    return predict_ensemble(stack, X, meta=meta)
+
+
+@functools.partial(jax.jit, static_argnames=("meta",))
+def predict_ensemble_binned(stack: EnsembleStack, bins_t: jax.Array,
+                            feat_tbl: Optional[jax.Array] = None, *,
+                            meta: EnsembleMeta) -> jax.Array:
+    """Raw per-class scores over the BINNED store — [K, N] f32.
+
+    bins_t: [N+1, C] int store bins (the ScoreUpdater layout — C is
+    original features, or bundled columns with `feat_tbl`).  Compares
+    stay integer end to end (bin codes vs in-bin thresholds), so replay
+    skips float thresholding entirely.  `feat_tbl` ([5, F]: col, offset,
+    default, nslots, packed) is the EFB packed-slot remap of
+    score_updater._walk_step: trees speak original (feature, bin) space,
+    the store speaks bundle space.
+    """
+    N = bins_t.shape[0] - 1
+    bins_nt = bins_t[:N].astype(jnp.int32)
+    T = stack.nodes.shape[0]
+    rows = jnp.arange(N)[None, :]
+    node = jnp.broadcast_to(stack.root[:, None], (T, N))
+    ft = None if feat_tbl is None else feat_tbl.astype(jnp.int32)
+
+    def step(_, node):
+        safe = jnp.maximum(node, 0)
+        rec = jnp.take_along_axis(stack.nodes, safe[:, :, None], axis=1)
+        f = rec[..., 0]
+        t = rec[..., 1]
+        if ft is None:
+            bv = bins_nt[rows, f]
+        else:
+            col = ft[0, f]
+            off = ft[1, f]
+            dflt = ft[2, f]
+            ns = ft[3, f]
+            pk = ft[4, f] > 0
+            bv_store = bins_nt[rows, col]
+            s = bv_store - off
+            in_r = (s >= 0) & (s < ns)
+            orig = jnp.where(in_r, s + (s >= dflt).astype(jnp.int32), dflt)
+            bv = jnp.where(pk, orig, bv_store)
+        if meta.any_cat:
+            gl = jnp.where(rec[..., 2] == 1, bv == t, bv <= t)
+        else:
+            gl = bv <= t
+        nxt = jnp.where(gl, rec[..., 3], rec[..., 4])
+        return jnp.where(node >= 0, nxt, node)
+
+    node = jax.lax.fori_loop(0, meta.depth, step, node)
+    return _leaf_sums(stack, node, meta.num_class)

@@ -138,7 +138,8 @@ def observe(name: str, value: float) -> None:
 
 
 def summary(name: str) -> Dict[str, float]:
-    """count/p50/p95/max over the retained samples of `name`."""
+    """count/p50/p95/p99/max over the retained samples of `name` — p99
+    is the serving SLO metric the sustained-QPS bench gates on."""
     with _lock:
         vals = sorted(_samples.get(name, ()))
     if not vals:
@@ -146,7 +147,7 @@ def summary(name: str) -> Dict[str, float]:
     def q(p: float) -> float:
         return vals[min(len(vals) - 1, int(p * len(vals)))]
     return {"count": len(vals), "p50": q(0.50), "p95": q(0.95),
-            "max": vals[-1]}
+            "p99": q(0.99), "max": vals[-1]}
 
 
 def timings() -> Dict[str, float]:

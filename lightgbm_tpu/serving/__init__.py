@@ -2,23 +2,30 @@
 
 Four layers, composed bottom-up:
 
-- `runtime`  — PredictorRuntime: AOT-compiled executables cached per
-  (model generation, row bucket, output kind); power-of-two bucketing +
-  padding keeps every request on a warm executable.
-- `batcher`  — MicroBatcher: coalesces concurrent requests up to
-  `max_batch_rows` or a `flush_deadline_ms` deadline, scatters results
-  back per request.
+- `runtime`  — PredictorRuntime: the model replicated across local
+  devices (least-loaded dispatch), AOT-compiled executables cached per
+  (replica, model generation, row bucket, output kind); power-of-two
+  bucketing + padding keeps every request on a warm executable; the
+  ensemble traversal is the `predict_kernel` dial (tensorized | walk,
+  ops/predict.py).
+- `batcher`  — MicroBatcher: continuous batching — admits concurrent
+  requests into the forming batch up to `max_batch_rows` or a
+  `flush_deadline_ms` deadline (monotonic clock), one flusher per
+  replica, optional admission control (`max_pending_rows` → 503).
 - `registry` — ModelRegistry: versioned atomic hot-swap (mtime poll or
-  SIGHUP) with pre-swap warmup and rollback on a bad model.
+  SIGHUP) with pre-swap warmup of every traffic bucket for BOTH output
+  kinds, and rollback on a bad model.
 - `server`   — PredictionServer: stdlib JSON-lines HTTP endpoint
   (/predict, /healthz, /stats), the `task=serve` CLI entry.
 """
-from .runtime import PredictorRuntime, row_bucket
-from .batcher import MicroBatcher
+from .runtime import (OUTPUT_KINDS, PredictorRuntime,
+                      resolve_serve_replicas, row_bucket)
+from .batcher import MicroBatcher, ServerOverloadedError
 from .registry import ModelRegistry
 from .server import PredictionServer, serve_from_config, server_from_config
 
 __all__ = [
-    "PredictorRuntime", "row_bucket", "MicroBatcher", "ModelRegistry",
+    "OUTPUT_KINDS", "PredictorRuntime", "resolve_serve_replicas",
+    "row_bucket", "MicroBatcher", "ServerOverloadedError", "ModelRegistry",
     "PredictionServer", "serve_from_config", "server_from_config",
 ]

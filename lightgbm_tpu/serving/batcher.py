@@ -1,13 +1,29 @@
-"""Micro-batching request queue.
+"""Micro-batching request queue with continuous admission.
 
 Online traffic arrives as many small concurrent requests; the TPU wants
 few large shape-stable batches.  The batcher bridges the two: requests
-queue up and a single flusher thread coalesces them until either
+queue up and flusher threads coalesce them until either
 ``max_batch_rows`` are pending or the OLDEST request has waited
 ``flush_deadline_ms`` — the classic latency/throughput dial of
-accelerator serving stacks.  One runtime reference is pinned per flush,
-so every request in a batch scores against a single model generation
-even while a hot swap lands mid-flight.
+accelerator serving stacks.
+
+Batching is CONTINUOUS, not coalesce-then-flush: a batch keeps admitting
+arriving requests right up to the moment it is taken for dispatch, and
+with ``workers > 1`` (one flusher per predictor replica) the next batch
+forms and dispatches while earlier ones are still scoring — the fleet
+never idles behind a single in-flight batch.  One runtime reference is
+pinned per flush, so every request in a batch scores against a single
+model generation even while a hot swap lands mid-flight.
+
+Deadline math uses the injectable monotonic clock ``_now`` (defaults to
+``time.monotonic``): wall-clock jumps (NTP steps, manual clock changes)
+can neither stall a batch past its deadline nor double-flush one.
+
+``max_pending_rows`` adds admission control: once that many rows are
+queued, further ``submit``s shed load with ServerOverloadedError
+instead of growing an unbounded queue (the HTTP layer maps it to 503;
+a request below the high-water mark always admits, however large — the
+runtime chunks it — so the queue is bounded by cap + one request).
 """
 from __future__ import annotations
 
@@ -22,6 +38,15 @@ import numpy as np
 from .. import profiling
 from ..log import LightGBMError
 
+# monotonic clock for ALL deadline math — module-level and injectable so
+# the regression test can drive it; time.time() here would let a wall
+# clock stepping backwards park a batch forever
+_now = time.monotonic
+
+
+class ServerOverloadedError(LightGBMError):
+    """Queue beyond max_pending_rows — shed load (HTTP 503)."""
+
 
 class _Request:
     __slots__ = ("X", "kind", "future", "t_enqueue")
@@ -30,30 +55,38 @@ class _Request:
         self.X = X
         self.kind = kind
         self.future: Future = Future()
-        self.t_enqueue = time.perf_counter()
+        self.t_enqueue = _now()
 
 
 class MicroBatcher:
     """Coalesce concurrent predict requests into bucketed runtime calls.
 
     `source` is anything with a ``current()`` returning the active
-    PredictorRuntime (a ModelRegistry), or a runtime itself.
+    PredictorRuntime (a ModelRegistry), or a runtime itself.  `workers`
+    is the number of concurrent flusher threads — size it to the
+    runtime's replica count so every replica can have a batch in flight.
     """
 
     def __init__(self, source, *, max_batch_rows: int = 4096,
-                 flush_deadline_ms: float = 5.0):
+                 flush_deadline_ms: float = 5.0, workers: int = 1,
+                 max_pending_rows: int = 0):
         self._source = source
         self.max_batch_rows = max(1, int(max_batch_rows))
         self.flush_deadline_s = max(0.0, float(flush_deadline_ms)) / 1e3
+        self.max_pending_rows = max(0, int(max_pending_rows))
+        self.workers = max(1, int(workers))
         self._cond = threading.Condition()
         self._queue: Deque[_Request] = deque()
         self._rows_pending = 0
         self._closed = False
         self.batches_flushed = 0
-        self._thread = threading.Thread(target=self._loop,
-                                        name="lgbt-serve-batcher",
-                                        daemon=True)
-        self._thread.start()
+        self.rejected = 0
+        self._threads = [
+            threading.Thread(target=self._loop,
+                             name=f"lgbt-serve-batcher-{i}", daemon=True)
+            for i in range(self.workers)]
+        for t in self._threads:
+            t.start()
 
     # -- client side ----------------------------------------------------
 
@@ -70,6 +103,18 @@ class MicroBatcher:
         with self._cond:
             if self._closed:
                 raise LightGBMError("batcher is closed")
+            # high-water-mark check: reject only when the queue is
+            # already at/over the cap, so a single request larger than
+            # the cap still lands on an idle server (the runtime chunks
+            # arbitrarily large batches); the queue stays bounded by
+            # cap + one request
+            if (self.max_pending_rows
+                    and self._rows_pending >= self.max_pending_rows):
+                self.rejected += 1
+                profiling.count("serve.rejected")
+                raise ServerOverloadedError(
+                    f"serving queue full ({self._rows_pending} rows "
+                    f"pending, cap {self.max_pending_rows}); retry later")
             self._queue.append(req)
             self._rows_pending += X.shape[0]
             depth = len(self._queue)
@@ -84,17 +129,20 @@ class MicroBatcher:
             return len(self._queue)
 
     def close(self) -> None:
-        """Stop accepting work, flush what is queued, join the thread."""
+        """Stop accepting work, flush what is queued, join the threads."""
         with self._cond:
             self._closed = True
             self._cond.notify_all()
-        self._thread.join(timeout=30)
+        for t in self._threads:
+            t.join(timeout=30)
 
     # -- flusher side ---------------------------------------------------
 
     def _take_batch(self) -> Optional[List[_Request]]:
         """Block until a batch is due (rows cap reached, deadline hit, or
-        close); None means closed-and-drained."""
+        close); None means closed-and-drained.  The batch admits every
+        request that arrives before it is taken — admission closes at
+        dispatch, not at first-request time."""
         with self._cond:
             while not self._queue:
                 if self._closed:
@@ -103,12 +151,17 @@ class MicroBatcher:
             deadline = self._queue[0].t_enqueue + self.flush_deadline_s
             while (self._rows_pending < self.max_batch_rows
                    and not self._closed):
-                remaining = deadline - time.perf_counter()
+                remaining = deadline - _now()
                 if remaining <= 0:
                     break
                 self._cond.wait(timeout=remaining)
-                if not self._queue:          # raced with close+drain
+                if not self._queue:
+                    # another worker (or close) drained it — go around
                     return None if self._closed else []
+                # the oldest request may have changed under a concurrent
+                # worker; recompute so this batch's deadline tracks ITS
+                # oldest member, not a dispatched one's
+                deadline = self._queue[0].t_enqueue + self.flush_deadline_s
             batch: List[_Request] = []
             rows = 0
             while self._queue:
@@ -155,7 +208,7 @@ class MicroBatcher:
                 for req in reqs:
                     req.future.set_exception(e)
                 continue
-            now = time.perf_counter()
+            now = _now()
             off = 0
             for req in reqs:
                 n = req.X.shape[0]

@@ -26,7 +26,7 @@ from typing import Optional, Sequence, Tuple
 
 from .. import log, profiling
 from ..log import LightGBMError
-from .runtime import PredictorRuntime
+from .runtime import OUTPUT_KINDS, PredictorRuntime
 
 
 def _file_signature(path: str) -> Tuple[int, int]:
@@ -39,13 +39,18 @@ class ModelRegistry:
                  num_iteration: int = -1, max_batch_rows: int = 4096,
                  min_bucket_rows: int = 16,
                  warmup_buckets: Sequence[int] = (1,),
-                 warmup_kinds: Sequence[str] = ("value",)):
+                 warmup_kinds: Sequence[str] = OUTPUT_KINDS,
+                 predict_kernel: Optional[str] = None, replicas: int = 0):
         self.model_path = model_path
         self.params = dict(params or {})
         self.num_iteration = num_iteration
         self.max_batch_rows = max_batch_rows
         self.min_bucket_rows = min_bucket_rows
+        # BOTH output kinds warm by default: a value-only warmup left
+        # the first raw request compiling on the request path
         self.warmup_kinds = tuple(warmup_kinds)
+        self.predict_kernel = predict_kernel
+        self.replicas = replicas
         self._lock = threading.Lock()       # serializes WRITERS only
         self._failed_sig: Optional[Tuple[int, int]] = None
         self._hup_pending = False
@@ -76,7 +81,9 @@ class ModelRegistry:
         return PredictorRuntime(booster, num_iteration=self.num_iteration,
                                 max_batch_rows=self.max_batch_rows,
                                 min_bucket_rows=self.min_bucket_rows,
-                                generation=generation)
+                                generation=generation,
+                                predict_kernel=self.predict_kernel,
+                                replicas=self.replicas)
 
     def maybe_reload(self, force: bool = False) -> bool:
         """Swap in the model file if it changed; True iff a swap landed.
@@ -101,10 +108,13 @@ class ModelRegistry:
             try:
                 with profiling.phase("serve/swap", force=True):
                     runtime = self._load(generation=old.generation + 1)
-                    # warm every bucket the outgoing generation served
+                    # warm every bucket the outgoing generation served,
+                    # for BOTH this registry's warmup kinds and whatever
+                    # kinds actually saw traffic (so no post-swap request
+                    # of either output kind compiles on the request path)
                     buckets = {b for b, _k in old.buckets_compiled()} or {1}
                     kinds = ({k for _b, k in old.buckets_compiled()}
-                             or set(self.warmup_kinds))
+                             | set(self.warmup_kinds))
                     runtime.warmup(sorted(buckets), sorted(kinds))
             except Exception as e:
                 self.swap_failures += 1

@@ -7,25 +7,36 @@ odd-shaped requests against one slowly-changing model.  This runtime
 keeps the accelerator executable warm the way the GPU boosting serving
 literature prescribes (arXiv:1806.11248 §5, arXiv:2011.02022):
 
-- executables are AOT-compiled once per (model generation, row bucket,
-  output kind) via ``jax.jit(...).lower(...).compile()`` and cached —
-  a cache hit does zero tracing and zero compilation;
+- executables are AOT-compiled once per (replica, model generation, row
+  bucket, output kind) via ``jax.jit(...).lower(...).compile()`` and
+  cached — a cache hit does zero tracing and zero compilation;
 - request rows are bucketed to powers of two between
   ``min_bucket_rows`` and ``max_batch_rows`` and padded up, so every
   shape in the wild lands on one of O(log) warm executables;
+- the ensemble traversal itself is the ``predict_kernel`` dial
+  (ops/predict.py): ``tensorized`` (the `auto` resolution) walks every
+  tree of every class in ONE fused gather/select program — `depth` loop
+  steps for the whole ensemble; ``walk`` keeps the per-class vmapped
+  walk as the A/B baseline;
+- the model is REPLICATED across local devices (`replicas`): each
+  replica owns a device-resident copy of the stacked ensemble and its
+  own executable cache, and requests dispatch to the least-loaded
+  replica — every local chip serves, which is the fleet story behind
+  "heavy traffic from millions of users";
 - the per-request feature buffer is donated on accelerator backends, so
   XLA may reuse it for the output and skip one HBM round trip;
 - the sigmoid/softmax output transform runs inside the compiled program
   ("value" kind) — the host only sees finished predictions.
 
-Cache hits/misses, compile seconds, and executed rows are recorded
-through the always-on `profiling` counters (exposed at the server's
-/stats endpoint).
+Cache hits/misses, compile seconds, executed rows, and per-replica
+dispatch counts are recorded through the always-on `profiling` counters
+(exposed at the server's /stats endpoint).
 """
 from __future__ import annotations
 
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -44,6 +55,38 @@ def row_bucket(n: int, min_bucket: int, max_bucket: int) -> int:
     return min(b, max_bucket)
 
 
+def resolve_serve_replicas(replicas: int = 0) -> list:
+    """The local devices a serving fleet replicates onto.
+
+    ``0`` (auto) = every local device on accelerator backends, ONE on
+    the CPU tier (the virtual host-platform devices jax carves out of
+    one socket share the same cores — replicating executables there
+    multiplies compile time, not throughput).  An explicit count is
+    honored on any backend (tests and the CPU bench force it), capped
+    at the local device count.
+    """
+    import jax
+    devs = list(jax.local_devices())
+    if replicas <= 0:
+        return devs if jax.default_backend() in ("tpu", "gpu") else devs[:1]
+    return devs[: min(replicas, len(devs))]
+
+
+class _Replica:
+    """One device's copy of the model: device-resident stacks plus its
+    own executable cache and dispatch bookkeeping."""
+    __slots__ = ("index", "device", "stacks", "compiled", "inflight",
+                 "dispatches")
+
+    def __init__(self, index: int, device, stacks):
+        self.index = index
+        self.device = device
+        self.stacks = stacks
+        self.compiled: Dict[Tuple[int, str], object] = {}
+        self.inflight = 0
+        self.dispatches = 0
+
+
 class PredictorRuntime:
     """Warm-executable predictor for one model generation.
 
@@ -54,10 +97,10 @@ class PredictorRuntime:
 
     def __init__(self, booster, *, num_iteration: int = -1,
                  max_batch_rows: int = 4096, min_bucket_rows: int = 16,
-                 generation: int = 0):
+                 generation: int = 0, predict_kernel: Optional[str] = None,
+                 replicas: int = 0):
         import jax
-        import jax.numpy as jnp
-        from ..ops.predict import stack_trees
+        from ..ops.predict import resolve_predict_kernel
 
         gbdt = booster._gbdt if hasattr(booster, "_gbdt") else booster
         gbdt._flush_pending()
@@ -73,29 +116,78 @@ class PredictorRuntime:
         self.objective = gbdt.objective
         self.K = max(1, gbdt.K)
         self.num_features = gbdt.max_feature_idx + 1
+        if predict_kernel is None:
+            # the model's own training config carries the dial when the
+            # serving entry point does not pass one explicitly
+            predict_kernel = getattr(getattr(gbdt, "config", None),
+                                     "predict_kernel", "auto")
+        self.predict_kernel = resolve_predict_kernel(predict_kernel)
         used = gbdt._num_used_models(num_iteration)
-        # one stacked-tree pytree per class; None for a class that never
-        # trained (its raw score stays 0, like GBDT._predict_raw_device)
-        self._stacks: List = []
-        self._depths: List[int] = []
-        for k in range(self.K):
-            trees = [gbdt.models[i] for i in range(used) if i % self.K == k]
-            if not trees:
-                self._stacks.append(None)
-                self._depths.append(1)
-                continue
-            stack = stack_trees(trees, binned=False)
-            self._stacks.append(jax.tree_util.tree_map(jax.device_put, stack))
-            self._depths.append(
-                max(max((t.max_depth_grown for t in trees), default=1), 1))
+        host_stacks = self._build_host_stacks(gbdt, used)
         self._device_value = self._device_value_fn()
         # X is donated only where donation is real; on CPU it would just
         # print an "unusable donated buffer" warning per call
         self._donate = jax.default_backend() in ("tpu", "gpu")
-        self._compiled: Dict[Tuple[int, str], object] = {}
+        # the fleet: one model copy + executable cache per local device
+        self.replicas: List[_Replica] = [
+            _Replica(i, dev, jax.device_put(host_stacks, dev))
+            for i, dev in enumerate(resolve_serve_replicas(replicas))]
+        # persistent chunk fan-out pool (threads spawn on demand): a
+        # per-request executor would pay thread spawn/teardown inside
+        # the serving hot path on every multi-chunk request.  Replicas
+        # are the parallel resource, so the pool is sized to the fleet
+        # and shared across concurrent requests; workers exit when the
+        # runtime is garbage-collected after a hot swap.
+        self._fanout = (ThreadPoolExecutor(
+            max_workers=len(self.replicas),
+            thread_name_prefix="lgbt-serve-fanout")
+            if len(self.replicas) > 1 else None)
         self._lock = threading.Lock()
+        self._rr = 0                  # round-robin tie-break cursor
         self.cache_hits = 0
         self.cache_misses = 0
+
+    @property
+    def replica_count(self) -> int:
+        return len(self.replicas)
+
+    def replica_dispatches(self) -> List[int]:
+        """Per-replica dispatch counts (the /stats fleet view)."""
+        with self._lock:
+            return [r.dispatches for r in self.replicas]
+
+    # -- model stacking -------------------------------------------------
+
+    def _build_host_stacks(self, gbdt, used: int):
+        """Host-numpy ensemble stacks — device_put once per replica.
+
+        tensorized: ONE stack over every class (`self._meta` static).
+        walk: one TreeStack per class (None for a never-trained class,
+        its raw row stays 0 like GBDT._predict_raw_device).
+        """
+        from ..ops.predict import build_ensemble, stack_trees
+        trees_by_class = [
+            [gbdt.models[i] for i in range(used) if i % self.K == k]
+            for k in range(self.K)]
+        if self.predict_kernel == "tensorized":
+            stack, meta = build_ensemble(trees_by_class, binned=False)
+            self._meta = meta
+            return stack
+        self._meta = None
+        stacks: List = []
+        self._depths: List[int] = []
+        for trees in trees_by_class:
+            if not trees:
+                stacks.append(None)
+                self._depths.append(1)
+                continue
+            # stack_trees returns device arrays on the default device;
+            # numpy round-trip keeps replica placement explicit
+            stack = stack_trees(trees, binned=False)
+            stacks.append(type(stack)(*map(np.asarray, stack)))
+            self._depths.append(
+                max(max((t.max_depth_grown for t in trees), default=1), 1))
+        return stacks
 
     # -- compiled-program construction ---------------------------------
 
@@ -126,47 +218,65 @@ class PredictorRuntime:
         return kind if kind == "raw" or self._device_value is not None \
             else "raw"
 
-    def _build(self, bucket: int, kind: str):
-        """AOT-compile the walker for one (bucket, kind) — the only
-        place an XLA compilation can happen after the runtime is built."""
+    def _raw_fn(self):
+        """The traced ensemble-traversal body, (stacks, X) -> [K, rows]."""
+        if self.predict_kernel == "tensorized":
+            from ..ops.predict import predict_ensemble_any
+            meta = self._meta
+
+            def fn(stacks, X):
+                return predict_ensemble_any(stacks, X, meta=meta)
+            return fn
+        from ..ops.predict import ensemble_raw
+        depths = tuple(self._depths)
+
+        def fn(stacks, X):
+            return ensemble_raw(stacks, X, depths=depths)
+        return fn
+
+    def _build(self, replica: _Replica, bucket: int, kind: str):
+        """AOT-compile the traversal for one (replica, bucket, kind) —
+        the only place an XLA compilation can happen after the runtime
+        is built."""
         import jax
         import jax.numpy as jnp
-        from ..ops.predict import ensemble_raw
+        from jax.sharding import SingleDeviceSharding
 
-        depths = tuple(self._depths)
+        raw_fn = self._raw_fn()
         device_value = self._device_value if kind == "value" else None
 
         def fn(stacks, X):
-            raw = ensemble_raw(stacks, X, depths=depths)   # [K, bucket]
+            raw = raw_fn(stacks, X)                        # [K, bucket]
             if device_value is not None:
                 raw = device_value(raw)
             return raw
 
         donate = (1,) if self._donate else ()
+        x_spec = jax.ShapeDtypeStruct(
+            (bucket, self.num_features), jnp.float32,
+            sharding=SingleDeviceSharding(replica.device))
         t0 = time.perf_counter()
         compiled = (jax.jit(fn, donate_argnums=donate)
-                    .lower(self._stacks,
-                           jax.ShapeDtypeStruct((bucket, self.num_features),
-                                                jnp.float32))
+                    .lower(replica.stacks, x_spec)
                     .compile())
         dt = time.perf_counter() - t0
         profiling.add("serve/compile", dt, force=True)
         profiling.count("serve.compile_seconds", dt)
         return compiled
 
-    def _get_executable(self, bucket: int, kind: str):
+    def _get_executable(self, replica: _Replica, bucket: int, kind: str):
         key = (bucket, kind)
         with self._lock:
-            exe = self._compiled.get(key)
+            exe = replica.compiled.get(key)
             if exe is not None:
                 self.cache_hits += 1
                 profiling.count("serve.cache_hit")
                 return exe
         # compile outside the lock (minutes-long on big models); the
         # double-build race just wastes one compile, never corrupts
-        exe = self._build(bucket, kind)
+        exe = self._build(replica, bucket, kind)
         with self._lock:
-            winner = self._compiled.setdefault(key, exe)
+            winner = replica.compiled.setdefault(key, exe)
             self.cache_misses += 1
             profiling.count("serve.cache_miss")
         return winner
@@ -174,34 +284,71 @@ class PredictorRuntime:
     # -- introspection --------------------------------------------------
 
     def buckets_compiled(self) -> List[Tuple[int, str]]:
+        """Distinct (bucket, kind) pairs compiled on ANY replica."""
         with self._lock:
-            return sorted(self._compiled)
+            keys = set()
+            for r in self.replicas:
+                keys.update(r.compiled)
+            return sorted(keys)
 
     def warmup(self, buckets: Sequence[int] = (),
-               kinds: Sequence[str] = ("value",)) -> None:
-        """Compile + execute the given row buckets so the first real
-        request after a (re)load never pays compile latency.  Used by
-        ModelRegistry before a hot swap goes live."""
+               kinds: Sequence[str] = OUTPUT_KINDS) -> None:
+        """Compile + execute the given row buckets on EVERY replica so
+        the first real request after a (re)load never pays compile
+        latency.  Defaults to BOTH output kinds: a value-only warmup
+        used to leave the first "raw" request compiling on the request
+        path (identity objectives share one program, so warming both is
+        free there).  Used by ModelRegistry before a hot swap goes
+        live."""
         buckets = sorted({row_bucket(b, self.min_bucket_rows,
                                      self.max_batch_rows)
                           for b in (buckets or (1,))})
-        for b in buckets:
-            for kind in kinds:
-                zeros = np.zeros((b, self.num_features), np.float32)
-                self._run_compiled(b, self._run_kind(kind), zeros)
+        run_kinds = sorted({self._run_kind(k) for k in kinds})
+        for replica in self.replicas:
+            for b in buckets:
+                for kind in run_kinds:
+                    zeros = np.zeros((b, self.num_features), np.float32)
+                    self._run_compiled(b, kind, zeros, replica=replica)
 
     # -- prediction -----------------------------------------------------
 
-    def _run_compiled(self, bucket: int, kind: str, Xpad: np.ndarray):
+    def _pick_replica(self) -> _Replica:
+        """Least-loaded dispatch with a round-robin tie-break, so an
+        idle fleet still spreads sequential traffic."""
+        with self._lock:
+            n = len(self.replicas)
+            best = None
+            for off in range(n):
+                r = self.replicas[(self._rr + off) % n]
+                if best is None or r.inflight < best.inflight:
+                    best = r
+            self._rr = (best.index + 1) % n
+            best.inflight += 1
+            best.dispatches += 1
+            return best
+
+    def _run_compiled(self, bucket: int, kind: str, Xpad: np.ndarray,
+                      replica: Optional[_Replica] = None):
         import jax
-        exe = self._get_executable(bucket, kind)
-        # explicit device_put/device_get keeps the serving loop clean
-        # under the sanitizer's transfer guard (BENCH_SANITIZE in
-        # scripts/bench_serve.py): implicit conversions here would be
-        # one h2d + one d2h violation per request
-        out = exe(self._stacks,
-                  jax.device_put(Xpad.astype(np.float32, copy=False)))
-        return jax.device_get(out).astype(np.float64)    # [K, bucket]
+        if replica is None:
+            replica = self._pick_replica()
+        else:                          # warmup pins the replica itself
+            with self._lock:
+                replica.inflight += 1
+                replica.dispatches += 1
+        try:
+            exe = self._get_executable(replica, bucket, kind)
+            # explicit device_put/device_get keeps the serving loop clean
+            # under the sanitizer's transfer guard (BENCH_SANITIZE in
+            # scripts/bench_serve.py): implicit conversions here would be
+            # one h2d + one d2h violation per request
+            out = exe(replica.stacks,
+                      jax.device_put(Xpad.astype(np.float32, copy=False),
+                                     replica.device))
+            return jax.device_get(out).astype(np.float64)  # [K, bucket]
+        finally:
+            with self._lock:
+                replica.inflight -= 1
 
     def _predict_chunk(self, X: np.ndarray, kind: str) -> np.ndarray:
         n = X.shape[0]
@@ -216,7 +363,10 @@ class PredictorRuntime:
 
         Arbitrary n: full ``max_batch_rows`` slabs plus one bucketed
         remainder, so every executed shape hits the warm cache — the
-        final partial chunk pads up instead of retracing.
+        final partial chunk pads up instead of retracing.  Each chunk
+        dispatches to the least-loaded replica independently — and
+        concurrently on a multi-replica fleet — so one large request
+        fans out across the fleet.
         """
         if kind not in OUTPUT_KINDS:
             raise ValueError(
@@ -239,10 +389,22 @@ class PredictorRuntime:
             return (np.zeros(0) if self.K == 1
                     else np.zeros((0, self.K)))
         run_kind = self._run_kind(kind)
+        starts = range(0, n, self.max_batch_rows)
         with profiling.phase("serve/execute", force=True):
-            parts = [self._predict_chunk(X[a:a + self.max_batch_rows],
-                                         run_kind)
-                     for a in range(0, n, self.max_batch_rows)]
+            if len(starts) == 1 or self._fanout is None:
+                parts = [self._predict_chunk(X[a:a + self.max_batch_rows],
+                                             run_kind)
+                         for a in starts]
+            else:
+                # a multi-chunk request on a multi-replica fleet really
+                # does fan out: chunks dispatch CONCURRENTLY (each
+                # dispatch picks the least-loaded replica), so
+                # wall-clock is ~chunks/replicas slabs, not a
+                # sequential scan that merely rotates replicas
+                parts = list(self._fanout.map(
+                    lambda a: self._predict_chunk(
+                        X[a:a + self.max_batch_rows], run_kind),
+                    starts))
         raw = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=1)
         out = raw[0] if self.K == 1 else raw.T
         if kind == "value" and run_kind == "raw" and self.objective is not None:

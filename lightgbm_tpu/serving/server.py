@@ -27,7 +27,7 @@ import numpy as np
 from .. import log, profiling
 from ..config import Config
 from ..log import LightGBMError
-from .batcher import MicroBatcher
+from .batcher import MicroBatcher, ServerOverloadedError
 from .registry import ModelRegistry
 
 _REQUEST_TIMEOUT_S = 120.0
@@ -123,6 +123,9 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, KeyError, json.JSONDecodeError) as e:
             self._respond_json(400, {"error": str(e)})
             return
+        except ServerOverloadedError as e:   # admission control: shed
+            self._respond_json(503, {"error": str(e)})
+            return
         except LightGBMError as e:
             self._respond_json(400, {"error": str(e)})
             return
@@ -149,12 +152,18 @@ class PredictionServer:
                  port: int = 0, max_batch_rows: int = 4096,
                  flush_deadline_ms: float = 5.0,
                  model_poll_seconds: float = 10.0,
-                 default_raw: bool = False):
+                 default_raw: bool = False, max_pending_rows: int = 0):
         self.registry = registry
         self.default_raw = default_raw
         self.model_poll_seconds = float(model_poll_seconds)
+        # one flusher per predictor replica: while one batch scores on a
+        # replica, the next forms and dispatches to an idle one —
+        # continuous batching across the fleet
+        workers = getattr(registry.current(), "replica_count", 1)
         self.batcher = MicroBatcher(registry, max_batch_rows=max_batch_rows,
-                                    flush_deadline_ms=flush_deadline_ms)
+                                    flush_deadline_ms=flush_deadline_ms,
+                                    workers=workers,
+                                    max_pending_rows=max_pending_rows)
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.prediction_server = self
@@ -180,6 +189,18 @@ class PredictionServer:
                 "misses": runtime.cache_misses,
                 "buckets": [list(k) for k in runtime.buckets_compiled()],
             },
+            # the fleet view: replica count, per-replica dispatch
+            # counters (least-loaded balance evidence), kernel in use
+            "replicas": {
+                "count": getattr(runtime, "replica_count", 1),
+                "dispatches": (runtime.replica_dispatches()
+                               if hasattr(runtime, "replica_dispatches")
+                               else []),
+                "predict_kernel": getattr(runtime, "predict_kernel",
+                                          "walk"),
+            },
+            "batch_workers": self.batcher.workers,
+            "rejected": self.batcher.rejected,
             "latency_ms": profiling.summary("serve.latency_ms"),
             "queue_depth_seen": profiling.summary("serve.queue_depth"),
             "swaps": self.registry.swaps,
@@ -236,13 +257,14 @@ def server_from_config(cfg: Config) -> PredictionServer:
         num_iteration=cfg.num_iteration_predict,
         max_batch_rows=cfg.max_batch_rows,
         min_bucket_rows=cfg.min_bucket_rows,
-        # warm the kind this server's default traffic will actually hit
-        warmup_kinds=("raw",) if cfg.is_predict_raw_score else ("value",))
+        predict_kernel=cfg.predict_kernel,
+        replicas=cfg.serve_replicas)
     return PredictionServer(
         registry, host=cfg.serve_host, port=cfg.serve_port,
         max_batch_rows=cfg.max_batch_rows,
         flush_deadline_ms=cfg.flush_deadline_ms,
         model_poll_seconds=cfg.model_poll_seconds,
+        max_pending_rows=cfg.max_pending_rows,
         default_raw=cfg.is_predict_raw_score)
 
 

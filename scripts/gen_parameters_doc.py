@@ -101,6 +101,30 @@ def main():
         "reaches ~1 MiB (`LGBT_HIST_EXCHANGE_MIN_BYTES` override), "
         "psum below it. See docs/Readme.md \"Histogram exchange\".",
         "",
+        "- `predict_kernel` (default `auto`, aliases "
+        "`prediction_kernel`, `predict_engine`): device ensemble-"
+        "traversal kernel. `tensorized` (the `auto` resolution) "
+        "flattens every tree of every class into one padded SoA and "
+        "advances all rows x all trees one depth level per step — "
+        "`depth` fused gather/select passes for the whole ensemble, "
+        "with shallow numerical ensembles re-laid out as perfect "
+        "binary trees (arithmetic navigation, fused leaf values); a "
+        "binned-input variant replays whole models onto validation "
+        "scores with integer bin compares.  `walk` keeps the per-class "
+        "vmapped tree walk as the A/B baseline.  See docs/serving.md.",
+        "- `serve_replicas` (default `0`, aliases `serving_replicas`, "
+        "`num_replicas`): serving-fleet size — compiled predictors "
+        "replicated across local devices with least-loaded dispatch.  "
+        "`0` = every local device on accelerator backends, one on the "
+        "CPU tier; an explicit count caps at the local device count.",
+        "- `max_pending_rows` (default `0`, aliases "
+        "`serve_max_pending_rows`, `pending_rows_cap`): admission "
+        "control — once this many rows are queued, further requests "
+        "shed load with HTTP 503 instead of growing an unbounded "
+        "queue.  High-water mark: a single over-cap request on an idle "
+        "server still admits (the runtime chunks it), bounding the "
+        "queue at cap + one request.  `0` = unbounded.",
+        "",
         "## Exclusive Feature Bundling",
         "",
         "- `enable_bundle` (default `True`, aliases `efb`, `bundle`): "

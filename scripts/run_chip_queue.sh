@@ -50,6 +50,10 @@ stage bench_narrow_on  env BENCH_ITERS=12 python bench.py || exit 1
 stage bench_sanitize_rounds env BENCH_SANITIZE=1 BENCH_TREE_GROWTH=rounds BENCH_ITERS=8 python bench.py || exit 1
 stage bench_sanitize_fused  env BENCH_SANITIZE=1 BENCH_TREE_GROWTH=exact  BENCH_ITERS=8 python bench.py || exit 1
 stage profile env BENCH_SANITIZE=1 python scripts/profile_hotpath.py || exit 1
+# serving fleet: sustained-QPS smoke + predict-kernel A/B at the
+# north-star model shape, gated on the sanitizer (0 retraces / 0
+# implicit transfers at steady state — fails AFTER its JSON prints)
+stage bench_serve env BENCH_SANITIZE=1 SERVE_BENCH_SECONDS=10 SERVE_BENCH_OUT=.bench/bench_serve.json python scripts/bench_serve.py || exit 1
 stage bench_narrow_off env LGBT_NARROW_ONEHOT=0 BENCH_ITERS=12 python bench.py || exit 1
 stage bench_part_off   env LGBT_FUSED_PARTITION=0 BENCH_ITERS=12 python bench.py || exit 1
 # 2. the 63-bin variant (VERDICT #2: reference accelerator sweet spot)

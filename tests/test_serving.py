@@ -255,6 +255,101 @@ def test_batcher_isolates_malformed_request(binary_model):
         mb.close()
 
 
+def test_batcher_monotonic_clock_regression(binary_model, monkeypatch):
+    """Deadline math runs on the injectable monotonic clock: a frozen
+    clock never flushes a partial batch early, and advancing it past the
+    deadline flushes exactly once — wall-clock (time.time) jumps cannot
+    stall or double-flush (they are simply never consulted)."""
+    import lightgbm_tpu.serving.batcher as batcher_mod
+    bst, X = binary_model
+    rt = PredictorRuntime(bst, max_batch_rows=1024)
+    fake = {"t": 1000.0}
+    monkeypatch.setattr(batcher_mod, "_now", lambda: fake["t"])
+    mb = MicroBatcher(rt, max_batch_rows=1024, flush_deadline_ms=10_000)
+    try:
+        fut = mb.submit(X[:3])
+        time.sleep(0.3)                 # real time passes, mock is frozen
+        assert not fut.done()           # deadline (mock) not reached
+        fake["t"] += 11.0               # jump past the 10 s deadline
+        fut2 = mb.submit(X[:2])         # notify wakes the flusher
+        preds = fut.result(timeout=30)
+        np.testing.assert_allclose(preds, bst.predict(X[:3]), atol=1e-6)
+        np.testing.assert_allclose(fut2.result(timeout=30),
+                                   bst.predict(X[:2]), atol=1e-6)
+        # both requests coalesced into ONE flush, not one each
+        assert mb.batches_flushed == 1
+    finally:
+        mb.close()
+
+
+def test_batcher_continuous_workers(binary_model):
+    """workers > 1: batches form and dispatch concurrently, every
+    request still resolves correctly."""
+    bst, X = binary_model
+    rt = PredictorRuntime(bst, max_batch_rows=64, min_bucket_rows=16)
+    mb = MicroBatcher(rt, max_batch_rows=64, flush_deadline_ms=5,
+                      workers=4)
+    ref = bst.predict(X)
+    errs = []
+
+    def client(lo, hi):
+        try:
+            got = mb.submit(X[lo:hi]).result(timeout=60)
+            np.testing.assert_allclose(got, ref[lo:hi], atol=1e-6)
+        except Exception as e:
+            errs.append(e)
+
+    try:
+        threads = [threading.Thread(target=client, args=(i * 8, i * 8 + 8))
+                   for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errs
+        assert mb.batches_flushed >= 1
+    finally:
+        mb.close()
+
+
+def test_batcher_admission_control(binary_model):
+    """Beyond max_pending_rows the batcher sheds load with
+    ServerOverloadedError instead of queueing without bound."""
+    import lightgbm_tpu as lgb_mod
+    from lightgbm_tpu.serving import ServerOverloadedError
+    bst, X = binary_model
+    release = threading.Event()
+
+    class SlowRuntime:
+        generation = 1
+
+        def predict(self, Xq, kind="value"):
+            release.wait(timeout=30)
+            return np.zeros(Xq.shape[0])
+
+    mb = MicroBatcher(SlowRuntime(), max_batch_rows=8,
+                      flush_deadline_ms=0, max_pending_rows=16,
+                      workers=1)
+    try:
+        first = mb.submit(X[:8])        # taken immediately, blocks worker
+        time.sleep(0.2)
+        futs = [mb.submit(X[:8]), mb.submit(X[:8])]   # 16 rows pending
+        with pytest.raises(ServerOverloadedError):
+            mb.submit(X[:8])            # queue at the 16-row cap
+        assert mb.rejected == 1
+        release.set()
+        for f in [first] + futs:
+            f.result(timeout=30)
+        # a request LARGER than the cap still lands once the queue
+        # drains (high-water mark, not per-request size limit)
+        big = mb.submit(X[:32]).result(timeout=30)
+        assert big.shape == (32,)
+    finally:
+        release.set()
+        mb.close()
+    assert isinstance(ServerOverloadedError("x"), lgb_mod.LightGBMError)
+
+
 # -- registry / hot swap -------------------------------------------------
 
 
@@ -326,10 +421,19 @@ def test_swap_warms_previous_buckets(tmp_path, binary_model):
     _save(bst, path)                 # same model, new mtime
     assert reg.maybe_reload() is True
     new_rt = reg.current()
-    assert new_rt.buckets_compiled() == old_buckets
-    # first post-swap request in a warmed bucket: zero new compiles
+    # every (bucket, kind) the outgoing generation served is warm, and
+    # every traffic bucket is warm for BOTH output kinds (a value-only
+    # swap warmup used to leave the first raw request compiling on the
+    # request path)
+    new_buckets = set(new_rt.buckets_compiled())
+    assert new_buckets >= set(old_buckets)
+    for b in {b for b, _k in old_buckets}:
+        assert (b, "value") in new_buckets and (b, "raw") in new_buckets
+    # first post-swap request in a warmed bucket: zero new compiles —
+    # for EITHER output kind
     misses = new_rt.cache_misses
     new_rt.predict(X[:37])
+    new_rt.predict(X[:37], kind="raw")
     assert new_rt.cache_misses == misses
 
 
@@ -382,15 +486,37 @@ def test_serve_config_keys_and_aliases():
     from lightgbm_tpu.config import config_from_params
     cfg = config_from_params({"task": "serve", "serving_port": 1234,
                               "batch_rows": 512, "flush_deadline": 7,
-                              "model_poll": 3})
+                              "model_poll": 3,
+                              "serve_max_pending_rows": 2048})
     assert cfg.serve_port == 1234
     assert cfg.max_batch_rows == 512
     assert cfg.flush_deadline_ms == 7.0
     assert cfg.model_poll_seconds == 3.0
+    assert cfg.max_pending_rows == 2048
+    assert config_from_params({"pending_rows_cap": 9}).max_pending_rows == 9
     with pytest.raises(ValueError):
         config_from_params({"serve_port": 99999})
     with pytest.raises(ValueError):
         config_from_params({"max_batch_rows": 0})
+    with pytest.raises(ValueError):
+        config_from_params({"max_pending_rows": -1})
+
+
+def test_server_from_config_wires_admission_control(tmp_path, binary_model):
+    """task=serve deployments can actually enable load shedding: the
+    max_pending_rows config key reaches the MicroBatcher."""
+    from lightgbm_tpu.config import config_from_params
+    from lightgbm_tpu.serving.server import server_from_config
+    bst, X = binary_model
+    mf = str(tmp_path / "m.txt")
+    bst.save_model(mf)
+    cfg = config_from_params({"task": "serve", "input_model": mf,
+                              "max_pending_rows": 128, "verbose": -1})
+    srv = server_from_config(cfg)
+    try:
+        assert srv.batcher.max_pending_rows == 128
+    finally:
+        srv.batcher.close()
 
 
 def test_serve_task_requires_model():
