@@ -41,14 +41,15 @@ __all__ = [
     "train", "cv", "early_stopping", "print_evaluation", "record_evaluation",
     "reset_parameter", "LGBMModel", "LGBMRegressor", "LGBMClassifier",
     "LGBMRanker", "plot_importance", "plot_metric", "plot_tree",
-    "create_tree_digraph", "serving",
+    "create_tree_digraph", "serving", "online",
 ]
 
 
 def __getattr__(name):
-    # the online-prediction subsystem is imported on first use so the
-    # training/CLI import path stays free of server machinery
-    if name == "serving":
+    # the online-prediction and online-learning subsystems are imported
+    # on first use so the training/CLI import path stays free of server
+    # and daemon machinery
+    if name in ("serving", "online"):
         import importlib
-        return importlib.import_module(".serving", __name__)
+        return importlib.import_module("." + name, __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -62,6 +62,10 @@ class Application:
             self._predict()
         elif self.config.task in ("serve", "serving"):
             self._serve()
+        elif self.config.task in ("online", "online_train"):
+            self._online()
+        elif self.config.task in ("refit", "refit_tree"):
+            self._refit()
         else:
             raise LightGBMError(f"unknown task: {self.config.task}")
 
@@ -146,6 +150,48 @@ class Application:
     def _serve(self) -> None:
         from .serving.server import serve_from_config
         serve_from_config(self.config)
+
+    # ------------------------------------------------------------------
+    def _online(self) -> None:
+        """task=online: the continuous refresh daemon (online/trainer.py)
+        — watch a labeled-traffic JSONL, refit/continue on trigger,
+        publish generations to the registry path."""
+        from .online.trainer import OnlineTrainer
+        OnlineTrainer.from_config(self.config).run_forever()
+
+    # ------------------------------------------------------------------
+    def _refit(self) -> None:
+        """task=refit (reference task=refit_tree): one-shot leaf-value
+        refit of input_model on a labeled data file, saved to
+        output_model."""
+        cfg = self.config
+        if not cfg.data:
+            raise LightGBMError("no refit data: set data=<file>")
+        if not cfg.input_model:
+            raise LightGBMError("no model: set input_model=<file>")
+        from .online.refit import refit_gbdt
+        ds = RawDataset.from_file(cfg.data, cfg)
+        gbdt = create_boosting(cfg, cfg.input_model)
+        # plain text files re-parse cheaply, so route on the RAW
+        # feature values (exact, Booster.refit parity); binary stores
+        # and selector-remapped files keep the binned fallback
+        leaf = None
+        if (not RawDataset._is_binary_file(cfg.data)
+                and not cfg.use_two_round_loading
+                and not (cfg.weight_column or cfg.group_column
+                         or cfg.ignore_column)):
+            label_idx = (int(cfg.label_column) if cfg.label_column
+                         and not cfg.label_column.startswith("name:")
+                         else 0)
+            X, _, _ = parse_text_file(cfg.data, cfg.has_header, label_idx)
+            if len(X) == ds.num_data:
+                leaf = gbdt.predict_leaf_index(X)
+        stats = refit_gbdt(gbdt, ds, leaf_idx=leaf)
+        gbdt.save_model_to_file(cfg.output_model)
+        _log(cfg, f"refit {stats['trees_refit']} of {stats['trees']} "
+                  f"trees on {stats['rows']} rows "
+                  f"(decay {stats['decay_rate']:g}); model saved to "
+                  f"{cfg.output_model}")
 
 
 class Predictor:

@@ -435,6 +435,43 @@ class Booster:
                 apply(vname, np.asarray(su.get()).reshape(-1), vdata)
         return out
 
+    # -- refit (upstream Booster.refit parity) ------------------------------
+
+    def refit(self, data, label, decay_rate: float = 0.9, weight=None,
+              **kwargs) -> "Booster":
+        """Refit the existing model's LEAF VALUES on new data (tree
+        structures unchanged) and return the refitted Booster; `self`
+        is untouched (upstream ``Booster.refit(data, label,
+        decay_rate)`` contract).
+
+        new_leaf = decay_rate * old + (1 - decay_rate) * newton_output
+        — the online-learning refit kernel (lightgbm_tpu/online/refit.py):
+        one binned ensemble traversal routes every row, one jitted scan
+        recomputes every tree's leaves.  kwargs become dataset/refit
+        params (e.g. ``refit_min_rows``).
+        """
+        if label is None:
+            raise ValueError("refit needs labels")
+        params = dict(self.params)
+        params.update(kwargs)
+        new = Booster(params=params, model_str=self.model_to_string())
+        data = _apply_pandas_categorical(data, self.pandas_categorical)
+        X = _to_numpy(data)
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        md = Metadata()
+        if weight is not None:
+            md.weights = _to_numpy(weight).reshape(-1).astype(np.float32)
+        inner = _InnerDataset(X, _to_numpy(label).reshape(-1),
+                              config_from_params(params), metadata=md)
+        from .online.refit import refit_gbdt
+        # route on the RAW feature values (upstream refit = pred_leaf
+        # then LGBM_BoosterRefit): exact, where the binned router would
+        # quantize thresholds falling inside this data's own bins
+        leaf = new._gbdt.predict_leaf_index(X)
+        refit_gbdt(new._gbdt, inner, decay_rate=decay_rate, leaf_idx=leaf)
+        return new
+
     # -- prediction ---------------------------------------------------------
 
     def predict(self, data, num_iteration: int = -1, raw_score: bool = False,

@@ -571,11 +571,50 @@ class GBDT:
 
     def predict_leaf_index(self, X: np.ndarray, num_iteration: int = -1
                            ) -> np.ndarray:
+        """Leaf index per (row, model) — [N, num_models] int32.
+
+        ``predict_kernel=walk`` is the host per-tree walk (exact f64
+        compares); ``tensorized`` routes through the device ensemble
+        leaf traversal (ops/predict.predict_ensemble_leaf) under the
+        same work gating as predict_raw.  The two return IDENTICAL
+        indices (tests/test_online.py leaf-parity suite): the device
+        stack is built one-class-per-tree in MODEL order (the class-
+        major flatten of the value kernels would silently permute
+        multiclass models' columns), and the device categorical compare
+        carries the host's explicit finite mask.
+        """
         self._flush_pending()
         X = np.ascontiguousarray(np.asarray(X, np.float64))
         used = self._num_used_models(num_iteration)
+        from ..ops.predict import resolve_predict_kernel
+        kernel = resolve_predict_kernel(self.config.predict_kernel)
+        force = os.environ.get("LIGHTGBM_TPU_DEVICE_PREDICT", "")
+        n = X.shape[0]
+        use_dev = (kernel == "tensorized" and used > 0 and force != "0"
+                   and (force == "1"
+                        or n * used >= self._DEVICE_PREDICT_MIN_WORK))
+        if use_dev:
+            return self._predict_leaf_device(X, used)
         return np.stack([self.models[i].predict_leaf_index(X)
                          for i in range(used)], axis=1)
+
+    def _predict_leaf_device(self, X: np.ndarray, used: int) -> np.ndarray:
+        """Tensorized leaf routing: ONE ensemble traversal for all
+        models (model-order stack, [T, N] leaves), chunked like the
+        value kernels."""
+        from ..ops.predict import predict_ensemble_leaf, stack_ensemble
+        key = ("leaf", used, len(self.models))
+        cached = self._predict_stack_cache.get(key)
+        if cached is None:
+            stack, meta = stack_ensemble(
+                [[self.models[i]] for i in range(used)], binned=False)
+            cached = self._cache_predict_stack(
+                key, (jax.device_put(stack), meta))
+        stack, meta = cached
+        out = np.zeros((used, X.shape[0]), np.int32)
+        self._run_chunked(
+            X, out, lambda c: predict_ensemble_leaf(stack, c, meta=meta))
+        return np.ascontiguousarray(out.T)
 
     def _num_used_models(self, num_iteration: int) -> int:
         n = len(self.models)

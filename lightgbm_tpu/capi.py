@@ -395,6 +395,23 @@ class CApiBooster:
         for t in og.models:
             g.models.append(t)
 
+    def refit(self, leaf_pred_addr: int, nrow: int, ncol: int) -> None:
+        """LGBM_BoosterRefit: refit the handle's model IN PLACE on its
+        training dataset's labels using caller-provided leaf
+        predictions ([nrow, ncol] int32 — one column per model, the
+        PredictForMat(PREDICT_LEAF) layout).  Delegates to the online
+        refit kernel with the routing step skipped (c_api.h
+        LGBM_BoosterRefit semantics; decay/min-rows come from the
+        booster's ``refit_decay_rate`` / ``refit_min_rows`` params)."""
+        if self.train_ds is None:
+            raise RuntimeError("refit needs the training dataset on the "
+                               "booster handle")
+        from .online.refit import refit_gbdt
+        leaf = _view(leaf_pred_addr, int(nrow) * int(ncol), 2).reshape(
+            int(nrow), int(ncol)).copy()
+        refit_gbdt(self.booster._gbdt, self.train_ds.require_finished(),
+                   leaf_idx=leaf)
+
     # -- eval ----------------------------------------------------------------
 
     def eval_names(self) -> List[str]:

@@ -116,6 +116,14 @@ PARAM_ALIASES: Dict[str, str] = {
     "pending_rows_cap": "max_pending_rows",
     "prediction_kernel": "predict_kernel",
     "predict_engine": "predict_kernel",
+    # online learning (task=online / task=refit, lightgbm_tpu/online/)
+    "decay_rate": "refit_decay_rate",
+    "refit_decay": "refit_decay_rate",
+    "min_refit_rows": "refit_min_rows",
+    "refit_min_data": "refit_min_rows",
+    "online_trigger": "online_trigger_rows",
+    "trigger_rows": "online_trigger_rows",
+    "refresh_mode": "online_mode",
     # exclusive feature bundling (EFB)
     "efb": "enable_bundle",
     "bundle": "enable_bundle",
@@ -348,6 +356,24 @@ class Config:
     # server still admits).  0 = unbounded.
     max_pending_rows: int = 0
 
+    # -- online learning (task=online / task=refit, lightgbm_tpu/online/)
+    # leaf-value refit blends the Newton leaf output computed on fresh
+    # labeled traffic with the old value: new = decay * old + (1 - decay)
+    # * computed (reference refit_decay_rate semantics; 0 = replace,
+    # 1 = freeze).
+    refit_decay_rate: float = 0.9
+    # leaves with fewer fresh rows than this keep their old value (a
+    # starved leaf's Newton step is noise); floors at 1 row.
+    refit_min_rows: int = 20
+    # the OnlineTrainer daemon refreshes the model once this many new
+    # labeled rows accumulated in the traffic window.
+    online_trigger_rows: int = 4096
+    # what a refresh does: "refit" reweights the existing tree
+    # structures (cheap — ~one traversal + one scan); "continue" appends
+    # num_iterations new trees on the fresh window via continued
+    # boosting (reset_training_data replay).
+    online_mode: str = "refit"
+
     # fields that are parsed but unused on TPU (accepted for compat)
     config_file: str = ""
     output_freq: int = 1
@@ -472,6 +498,15 @@ def check_param_conflict(cfg: Config) -> None:
         raise ValueError("max_pending_rows must be >= 0 (0 = unbounded)")
     if cfg.predict_kernel not in PREDICT_KERNELS:
         raise ValueError(f"unknown predict_kernel: {cfg.predict_kernel}")
+    if not (0.0 <= cfg.refit_decay_rate <= 1.0):
+        raise ValueError("refit_decay_rate must be in [0, 1]")
+    if cfg.refit_min_rows < 0:
+        raise ValueError("refit_min_rows must be >= 0")
+    if cfg.online_trigger_rows < 1:
+        raise ValueError("online_trigger_rows must be >= 1")
+    if cfg.online_mode not in ("refit", "continue"):
+        raise ValueError(f"unknown online_mode: {cfg.online_mode}; "
+                         "use refit or continue")
     if not (0.0 <= cfg.max_conflict_rate < 1.0):
         raise ValueError("max_conflict_rate must be in [0, 1)")
 

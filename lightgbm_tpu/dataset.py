@@ -36,6 +36,22 @@ from .config import Config
 # share a column — realized conflicts are counted exactly during binning)
 BUNDLE_PLAN_SAMPLE_CNT = 50_000
 
+# smallest row capacity of a streaming (appendable) dataset store; growth
+# doubles from here so the capacity ladder is a power-of-two tier set
+STREAM_CAPACITY_BASE = 1024
+
+
+def row_capacity_tier(n: int, base: int = STREAM_CAPACITY_BASE) -> int:
+    """Smallest power-of-two-of-`base` capacity >= n.  Device kernels over
+    a streaming store (online refit, binned replay) key their compiled
+    shapes on the CAPACITY, so appends within a tier never retrace and
+    the ladder bounds the total compile count at O(log rows)."""
+    cap = max(int(base), 1)
+    n = max(int(n), 1)
+    while cap < n:
+        cap <<= 1
+    return cap
+
 
 def _plan_bundles_from_sample(sample: np.ndarray, mappers: List[BinMapper],
                               used: List[int], cfg: Config
@@ -633,6 +649,122 @@ class Dataset:
             self.bundle_conflict_rows += pack_bundle_column(
                 b, int(plan.feat_default[k]), int(plan.feat_offset[k]),
                 self.bins[int(plan.feat_col[k])])
+
+    # -- streaming append path (online ingestion; ROADMAP items 1 + 5) ------
+    #
+    # A streaming dataset shares a reference dataset's FROZEN BinMappers
+    # and BundlePlan (no re-quantization — incoming chunks bin into the
+    # exact store layout the model's trees were rebinned to) and grows
+    # its [F_eff, capacity] store along a power-of-two capacity ladder,
+    # so the device kernels that consume it (online leaf refit, binned
+    # replay) compile once per TIER instead of once per append.
+
+    @property
+    def row_capacity(self) -> int:
+        """Allocated row slots of the store (== num_data except for
+        streaming datasets, whose store grows in capacity tiers)."""
+        return int(self.bins.shape[1])
+
+    @classmethod
+    def streaming_from(cls, reference: "Dataset",
+                       config: Optional[Config] = None,
+                       capacity: int = STREAM_CAPACITY_BASE) -> "Dataset":
+        """Empty appendable Dataset binning against `reference`'s frozen
+        mappers + bundle plan.  `capacity` seeds the tier ladder."""
+        cfg = config or reference.config
+        cap = row_capacity_tier(capacity)
+        ds = cls._empty_from_mappers(cfg, reference.mappers,
+                                     list(reference.used_features), cap,
+                                     reference.num_total_features,
+                                     list(reference.feature_names),
+                                     plan=reference.bundle_plan)
+        # the unbundled store allocates with np.empty; streaming slots
+        # beyond num_data must hold bin 0 (the branch-free sentinel
+        # value, and "all members at default" for packed columns)
+        ds.bins[:] = 0
+        ds.num_data = 0
+        return ds
+
+    def _reserve_rows(self, n: int) -> None:
+        """Grow the store to the next capacity tier holding n rows."""
+        cap = self.row_capacity
+        if n <= cap:
+            return
+        new_cap = row_capacity_tier(n, base=max(cap, 1) * 2)
+        grown = np.zeros((self.bins.shape[0], new_cap), self.bins.dtype)
+        grown[:, :cap] = self.bins
+        self.bins = grown
+        self._device_bins = None
+
+    def append_rows(self, X: np.ndarray, label=None, weight=None) -> int:
+        """Bin a chunk of raw rows into the store (frozen mappers, no
+        re-quantization) and append its labels/weights; returns the new
+        row count.  Appends within a capacity tier keep the store (and
+        therefore every compiled kernel shape over it) stable."""
+        X = np.ascontiguousarray(np.asarray(X, np.float64))
+        if X.ndim != 2 or X.shape[1] != self.num_total_features:
+            raise ValueError(
+                f"append_rows expects [rows, {self.num_total_features}] "
+                f"features, got {X.shape}")
+        n0, add = self.num_data, len(X)
+        if add == 0:
+            return n0
+        self._reserve_rows(n0 + add)
+        self._bin_rows_into(X, n0)
+        md = self.metadata
+        if label is not None:
+            lab = np.asarray(label, np.float32).reshape(-1)
+            if lab.size != add:
+                raise ValueError("label length mismatch")
+            if n0 and md.label.size != n0:
+                raise ValueError(
+                    "cannot append labeled rows to an unlabeled dataset")
+            md.label = np.concatenate([md.label, lab]) if n0 else lab
+        elif md.label.size:
+            raise ValueError(
+                "cannot append unlabeled rows to a labeled dataset")
+        if weight is not None:
+            w = np.asarray(weight, np.float32).reshape(-1)
+            if w.size != add:
+                raise ValueError("weight length mismatch")
+            if md.weights is None:
+                md.weights = (np.concatenate(
+                    [np.ones(n0, np.float32), w]) if n0 else w)
+            else:
+                md.weights = np.concatenate([md.weights, w])
+        elif md.weights is not None:
+            md.weights = np.concatenate(
+                [md.weights, np.ones(add, np.float32)])
+        self.num_data = n0 + add
+        self._device_bins = None
+        return self.num_data
+
+    def reset_rows(self) -> None:
+        """Drop all rows but KEEP the capacity tier — the online
+        trainer's per-refresh window: compiled kernel shapes over the
+        store survive the reset, so steady-state refits never retrace."""
+        self.bins[:] = 0
+        self.num_data = 0
+        self.bundle_conflict_rows = 0
+        self.metadata = Metadata()
+        self._device_bins = None
+
+    def compacted(self) -> "Dataset":
+        """Trimmed [F_eff, num_data] copy of a streaming dataset (the
+        capacity slack dropped) — what the training learners consume
+        (they size scores and partitions off the store width).  Metadata
+        is shared (its arrays are already logical-length)."""
+        ds = Dataset._empty_from_mappers(
+            self.config, self.mappers, list(self.used_features),
+            self.num_data, self.num_total_features,
+            list(self.feature_names), plan=self.bundle_plan)
+        # explicit copy: at num_data == capacity the slice is the whole
+        # array and ascontiguousarray would alias it — reset_rows()
+        # would then zero the "copy" in place
+        ds.bins = self.bins[:, : self.num_data].copy()
+        ds.bundle_conflict_rows = self.bundle_conflict_rows
+        ds.metadata = self.metadata
+        return ds
 
     @classmethod
     def from_csc(cls, sp_matrix, label: Optional[np.ndarray],

@@ -1,0 +1,23 @@
+"""Online learning: streaming ingestion, leaf refit, continuous publish.
+
+Closes the train→serve loop (ROADMAP item 5): models whose STRUCTURE
+was trained offline get their leaf VALUES refreshed continuously from
+labeled serving traffic, and each refreshed generation is published
+atomically to the path the serving ModelRegistry hot-swaps from — the
+production drift story with zero recompiles on the serving side.
+
+- `stream` — JSONL labeled-traffic reader + the Dataset append path's
+  front end (frozen bin mappers, capacity-tiered store growth);
+- `refit` — the leaf-value refit kernel (one binned ensemble traversal
+  to route rows, one jitted scan to recompute every tree's leaves:
+  reference GBDT::RefitTree semantics, `refit_decay_rate` blending,
+  `refit_min_rows` starvation guard);
+- `trainer` — the `task=online` daemon (watch traffic, refit or
+  continue-boost on trigger, publish generations + metadata sidecar).
+"""
+from .refit import LeafRefitter, refit_gbdt
+from .stream import TrafficLog, append_traffic
+from .trainer import OnlineTrainer
+
+__all__ = ["LeafRefitter", "refit_gbdt", "TrafficLog", "append_traffic",
+           "OnlineTrainer"]

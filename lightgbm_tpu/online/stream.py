@@ -1,0 +1,135 @@
+"""Labeled-traffic ingestion: JSON-lines reader for logged /predict
+traffic joined with labels.
+
+Line format (one example per line):
+
+    {"features": [f0, f1, ...], "label": y}
+    {"features": [f0, f1, ...], "label": y, "weight": w}
+    [y, f0, f1, ...]                      # plain-array shorthand
+
+which is exactly the serving request body's row shape
+(serving/server.py `_parse_predict_body`) plus the joined label — a log
+pipeline can append the label to each served row and feed the file
+straight back into the trainer.
+
+`TrafficLog` tails a GROWING file: it remembers its byte offset and
+only consumes complete lines, so a writer appending mid-poll never
+feeds the reader a torn record (the partial tail is re-read on the next
+poll once its newline lands).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def append_traffic(path: str, X: np.ndarray, y: np.ndarray,
+                   weight: Optional[np.ndarray] = None) -> int:
+    """Append labeled rows to a traffic log (the writer half — what a
+    serving-side label joiner produces); returns rows written."""
+    X = np.asarray(X, np.float64)
+    if X.ndim == 1:
+        X = X.reshape(1, -1)
+    y = np.asarray(y, np.float64).reshape(-1)
+    if len(y) != len(X):
+        raise ValueError("label length mismatch")
+    with open(path, "a") as f:
+        for i in range(len(X)):
+            rec = {"features": [float(v) for v in X[i]],
+                   "label": float(y[i])}
+            if weight is not None:
+                rec["weight"] = float(np.asarray(weight).reshape(-1)[i])
+            f.write(json.dumps(rec) + "\n")
+    return len(X)
+
+
+class TrafficLog:
+    """Incremental reader over a labeled-traffic JSONL file.
+
+    `expected_features` pins the row width (the model's feature count);
+    without it the width locks to the first well-formed line EVER read.
+    Either way the reference persists across polls, so one short-but-
+    parseable line can only lose itself — never become the yardstick
+    that rejects every valid row behind it.
+    """
+
+    def __init__(self, path: str, expected_features: Optional[int] = None,
+                 max_poll_bytes: int = 64 << 20):
+        self.path = path
+        self.offset = 0           # byte offset of the first unread line
+        self.rows_read = 0
+        self.bad_lines = 0
+        self._width = (int(expected_features)
+                       if expected_features else None)
+        # per-poll read cap: a daemon (re)started against a multi-GB
+        # backlog must drain it in bounded slices, not one giant blob
+        self._max_poll = int(max_poll_bytes)
+
+    def read_new(self) -> Optional[Tuple[np.ndarray, np.ndarray,
+                                         Optional[np.ndarray]]]:
+        """Consume every COMPLETE line past the last offset.
+
+        Returns (X, y, weights-or-None), or None when nothing new is
+        readable.  A file that shrank (rotation/truncation) restarts
+        from the top.  Malformed lines are counted and skipped — one
+        bad record must not wedge the ingestion loop.
+        """
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return None
+        if size < self.offset:      # rotated/truncated: start over
+            self.offset = 0
+        if size == self.offset:
+            return None
+        capped = size - self.offset > self._max_poll
+        with open(self.path, "rb") as f:
+            f.seek(self.offset)
+            blob = f.read(min(size - self.offset, self._max_poll))
+        last_nl = blob.rfind(b"\n")
+        if last_nl < 0:
+            if capped:              # a single over-cap line: skip it
+                # (its remainder parses as one more bad line later)
+                self.offset += len(blob)
+                self.bad_lines += 1
+            return None             # else: only a torn tail so far
+        consumed = blob[: last_nl + 1]
+        self.offset += len(consumed)
+        feats, labels, weights = [], [], []
+        any_weight = False
+        for line in consumed.decode("utf-8", errors="replace").splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                item = json.loads(line)
+                if isinstance(item, dict):
+                    row = [float(v) for v in item["features"]]
+                    lab = float(item["label"])
+                    w = item.get("weight")
+                else:               # [label, f0, f1, ...] shorthand
+                    lab = float(item[0])
+                    row = [float(v) for v in item[1:]]
+                    w = None
+            except (ValueError, TypeError, KeyError, IndexError):
+                self.bad_lines += 1
+                continue
+            if self._width is None:
+                self._width = len(row)
+            if len(row) != self._width:
+                self.bad_lines += 1
+                continue
+            feats.append(row)
+            labels.append(lab)
+            weights.append(1.0 if w is None else float(w))
+            any_weight = any_weight or w is not None
+        if not feats:
+            return None
+        self.rows_read += len(feats)
+        X = np.asarray(feats, np.float64)
+        y = np.asarray(labels, np.float64)
+        w = np.asarray(weights, np.float32) if any_weight else None
+        return X, y, w

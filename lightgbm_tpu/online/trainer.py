@@ -1,0 +1,257 @@
+"""`task=online`: the continuous train-side daemon.
+
+Watches a labeled-traffic JSONL file (the serving `/predict` log joined
+with labels — see stream.py), bins each new chunk against FROZEN bin
+mappers into a capacity-tiered streaming window, and when
+`online_trigger_rows` fresh rows have accumulated, refreshes the model:
+
+- ``online_mode=refit`` (default): reweight the existing tree
+  structures' leaves on the window (refit.LeafRefitter — ~one traversal
+  plus one scan; the compiled programs persist across refreshes, so the
+  loop holds the 0-retrace / 0-implicit-transfer contract);
+- ``online_mode=continue``: continued boosting — the existing
+  reset_training_data machinery replays the model onto the window's
+  scores and `num_iterations` fresh trees are appended.
+
+Each refresh PUBLISHES a new model generation atomically (tmp +
+os.replace) to `output_model` — the path a serving ModelRegistry polls
+— plus a ``<output_model>.meta.json`` sidecar (generation, mode, rows,
+timestamps) that the server surfaces at `/stats` as the `online` block.
+The serving fleet hot-swaps the refreshed generation with pre-warmed
+buckets and zero recompiles: leaf values changed, shapes did not.
+
+Bin mappers freeze at the FIRST trigger window (or from an explicit
+`reference` dataset): every later chunk re-uses them, so no chunk is
+ever re-quantized and the stores stay aligned with the trees' rebinned
+thresholds.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .. import log
+from ..config import Config, config_from_params
+from ..dataset import Dataset as RawDataset
+from ..log import LightGBMError
+from .refit import LeafRefitter
+from .stream import TrafficLog
+
+
+def _booster_params(cfg: Config) -> dict:
+    """Config -> Booster params dict (file/task routing keys dropped so
+    the loaded booster cannot accidentally re-trigger IO)."""
+    p = dataclasses.asdict(cfg)
+    for k in ("task", "data", "input_model", "output_model", "valid_data",
+              "output_result", "is_save_binary_file", "config_file"):
+        p.pop(k, None)
+    return p
+
+
+class OnlineTrainer:
+    """Traffic-watching refresh daemon (see module docstring)."""
+
+    def __init__(self, booster, traffic_path: str, publish_path: str, *,
+                 config: Optional[Config] = None, reference=None):
+        cfg = config or config_from_params(booster.params)
+        if not booster._gbdt.models:
+            raise LightGBMError("task=online needs a trained input model")
+        self.cfg = cfg
+        self.booster = booster
+        # pin the traffic row width to the model's feature count so a
+        # single malformed-width line can never become the yardstick
+        # that rejects the valid rows behind it
+        self.traffic = TrafficLog(traffic_path,
+                                  expected_features=booster.num_feature())
+        self.publish_path = publish_path
+        self.mode = cfg.online_mode
+        self.trigger = int(cfg.online_trigger_rows)
+        self.generation = 0
+        self.refreshes = 0
+        self.rows_seen = 0
+        # window state: raw chunks buffer until the first trigger
+        # freezes the bin mappers, then a streaming Dataset takes over
+        self._window: Optional[RawDataset] = None
+        self._buffer: List[Tuple[np.ndarray, np.ndarray,
+                                 Optional[np.ndarray]]] = []
+        self._buffered_rows = 0
+        self._refitter: Optional[LeafRefitter] = None
+        # refit mode routes each ingested chunk through the EXACT
+        # raw-feature leaf router while the raw values are still in
+        # hand (upstream pred_leaf refit parity — the window's binned
+        # store quantizes thresholds that fall inside its bins);
+        # structures are frozen in refit mode, so routing never stales
+        self._leaf_chunks: List[np.ndarray] = []
+        if reference is not None:
+            self._window = RawDataset.streaming_from(
+                reference, cfg, capacity=self.trigger)
+
+    @classmethod
+    def from_config(cls, cfg: Config) -> "OnlineTrainer":
+        from ..basic import Booster
+        if not cfg.input_model:
+            raise LightGBMError("task=online needs input_model=<file>")
+        if not cfg.data:
+            raise LightGBMError(
+                "task=online needs data=<labeled traffic .jsonl>")
+        if not cfg.output_model:
+            raise LightGBMError("task=online needs output_model=<registry "
+                                "path the serving fleet polls>")
+        booster = Booster(params=_booster_params(cfg),
+                          model_file=cfg.input_model)
+        return cls(booster, cfg.data, cfg.output_model, config=cfg)
+
+    # -- ingestion ------------------------------------------------------
+
+    def pending_rows(self) -> int:
+        return (self._window.num_data if self._window is not None
+                else self._buffered_rows)
+
+    def _ingest(self, X: np.ndarray, y: np.ndarray,
+                w: Optional[np.ndarray]) -> None:
+        self.rows_seen += len(X)
+        if self._window is not None:
+            self._window.append_rows(X, y, w)
+            if self.mode == "refit":
+                self._leaf_chunks.append(
+                    self.booster._gbdt.predict_leaf_index(X))
+            return
+        self._buffer.append((X, y, w))
+        self._buffered_rows += len(X)
+        if self._buffered_rows < self.trigger:
+            return
+        # first full window: freeze the bin mappers + bundle plan here;
+        # every later chunk bins against them (no re-quantization)
+        Xa = np.concatenate([b[0] for b in self._buffer])
+        ya = np.concatenate([b[1] for b in self._buffer])
+        wa = (np.concatenate([
+            np.ones(len(b[0]), np.float32) if b[2] is None else b[2]
+            for b in self._buffer])
+            if any(b[2] is not None for b in self._buffer) else None)
+        base = RawDataset(Xa, ya, self.cfg)
+        self._window = RawDataset.streaming_from(
+            base, self.cfg, capacity=max(self.trigger, len(Xa)))
+        # `base` already binned these exact rows against the mappers
+        # the window just froze — adopt its store instead of re-binning
+        # (append_rows produces bitwise-identical bins:
+        # tests/test_online.py::test_streaming_append_matches_batch_binning)
+        win = self._window
+        win.bins[:, : len(Xa)] = base.bins
+        win.num_data = len(Xa)
+        win.bundle_conflict_rows = base.bundle_conflict_rows
+        win.metadata.label = ya.astype(np.float32)
+        if wa is not None:
+            win.metadata.weights = wa.astype(np.float32)
+        win._device_bins = None
+        if self.mode == "refit":
+            self._leaf_chunks.append(
+                self.booster._gbdt.predict_leaf_index(Xa))
+        self._buffer = []
+        self._buffered_rows = 0
+        log.info(f"online: froze bin mappers from the first "
+                 f"{len(Xa)}-row window "
+                 f"({self._window.num_features} used features, "
+                 f"store capacity {self._window.row_capacity})")
+
+    # -- the loop -------------------------------------------------------
+
+    def poll_once(self) -> bool:
+        """Ingest any new traffic; refresh + publish when the trigger
+        fires.  Returns True iff a new generation was published."""
+        got = self.traffic.read_new()
+        if got is not None:
+            self._ingest(*got)
+        if self._window is None or self._window.num_data < self.trigger:
+            return False
+        return self.refresh()
+
+    def refresh(self) -> bool:
+        """Refresh the model on the current window (regardless of the
+        trigger), publish the new generation, reset the window."""
+        window = self._window
+        if window is None or window.num_data == 0:
+            return False
+        t0 = time.perf_counter()
+        if self.mode == "continue":
+            stats = self._continue_boosting(window)
+        else:
+            if self._refitter is None:
+                self._refitter = LeafRefitter(self.booster._gbdt, window)
+            # exact raw-feature routing accumulated at ingestion; the
+            # binned router only backstops a count mismatch (e.g. rows
+            # appended to the window behind the trainer's back)
+            leaf = (np.concatenate(self._leaf_chunks)
+                    if self._leaf_chunks else None)
+            if leaf is not None and len(leaf) != window.num_data:
+                leaf = None
+            stats = self._refitter.refit(leaf_idx=leaf)
+        stats["refresh_seconds"] = round(time.perf_counter() - t0, 4)
+        self.refreshes += 1
+        self._publish(stats)
+        window.reset_rows()
+        self._leaf_chunks = []
+        return True
+
+    def _continue_boosting(self, window: RawDataset) -> dict:
+        """Append num_iterations fresh trees on the window: the existing
+        continued-training machinery — reset_training_data replays the
+        model onto the window's scores (tensorized binned replay), then
+        ordinary boosting iterations grow new trees."""
+        g = self.booster._gbdt
+        inner = window.compacted()
+        before = len(g.models)
+        g.reset_training_data(inner, g.objective)
+        for _ in range(self.cfg.num_iterations):
+            if g.train_one_iter(None, None, False):
+                break
+        g._flush_pending()
+        self._refitter = None      # structure changed
+        return {"mode": "continue", "rows": int(inner.num_data),
+                "trees_before": before, "trees_after": len(g.models)}
+
+    def _publish(self, stats: dict) -> None:
+        """Atomically publish the refreshed model + metadata sidecar.
+        os.replace is atomic on one filesystem, so the registry's
+        (mtime, size) poll can never observe a half-written model."""
+        self.generation += 1
+        tmp = f"{self.publish_path}.g{self.generation}.tmp"
+        self.booster.save_model(tmp)
+        meta = {"generation": self.generation, "mode": self.mode,
+                "refreshes": self.refreshes,
+                "rows_seen": int(self.rows_seen),
+                "trigger_rows": self.trigger,
+                "published_unix": round(time.time(), 3), **stats}
+        mtmp = f"{self.publish_path}.meta.json.tmp"
+        with open(mtmp, "w") as f:
+            json.dump(meta, f)
+        # both files staged before either lands: the model/sidecar
+        # inconsistency window a /stats poll can observe is two
+        # back-to-back renames, not a model save + json dump
+        os.replace(tmp, self.publish_path)
+        os.replace(mtmp, self.publish_path + ".meta.json")
+        log.info(f"online: published generation {self.generation} "
+                 f"({self.mode}, {stats.get('rows', 0)} rows) to "
+                 f"{self.publish_path}")
+
+    def run_forever(self, poll_seconds: Optional[float] = None,
+                    stop: Optional[threading.Event] = None) -> None:
+        """Blocking poll loop; `stop` lets tests (and signal handlers)
+        end it cleanly."""
+        period = (self.cfg.model_poll_seconds if poll_seconds is None
+                  else float(poll_seconds)) or 1.0
+        stop = stop or threading.Event()
+        log.info(f"online: watching {self.traffic.path} every "
+                 f"{period:g}s (mode={self.mode}, trigger="
+                 f"{self.trigger} rows, publishing to "
+                 f"{self.publish_path})")
+        while not stop.wait(period):
+            try:
+                self.poll_once()
+            except Exception as e:   # never kill the daemon on one window
+                log.warning(f"online refresh failed: {e}")

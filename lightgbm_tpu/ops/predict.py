@@ -104,11 +104,12 @@ def _walk_one_tree(sf, th, dc, lc, rc, lv, nl, Xf, depth: int) -> jax.Array:
         v = jnp.take_along_axis(Xf, f[:, None], axis=1)[:, 0]
         t = th[safe]
         cat = dc[safe] == 1
-        # categorical: int truncation compare, matching the host walk
-        # (tree.py predict_leaf_index: v.astype(int64) == thr int64)
-        gl = jnp.where(cat,
-                       v.astype(jnp.int32) == t.astype(jnp.int32),
-                       v <= t)
+        # categorical: int truncation compare with the host walk's
+        # explicit finite mask (tree.py predict_leaf_index) — a bare
+        # int cast of NaN is backend-defined and could match category 0
+        finite = jnp.isfinite(v)
+        vi = jnp.where(finite, v, -1.0).astype(jnp.int32)
+        gl = jnp.where(cat, finite & (vi == t.astype(jnp.int32)), v <= t)
         nxt = jnp.where(gl, lc[safe], rc[safe])
         return jnp.where(node >= 0, nxt, node)
 
@@ -395,7 +396,8 @@ def predict_ensemble(stack: EnsembleStack, X: jax.Array, *,
     depth-loop per class and five gathers per level).
 
     Decision parity with `_walk_one_tree` is bitwise: numerical ``v <=
-    t`` (NaN falls right), categorical int-truncation compare.  Nodes
+    t`` (NaN falls right), categorical int-truncation compare behind
+    the host walk's finite mask (non-finite never matches).  Nodes
     with the default-left lane set route NaN/non-finite values LEFT on
     numerical splits (missing-value support; nothing sets it today, so
     the select is compiled out unless the stack carries one).
@@ -416,10 +418,14 @@ def predict_ensemble(stack: EnsembleStack, X: jax.Array, *,
         if meta.any_default_left:
             gl = jnp.where(jnp.isnan(v), rec[..., 5] > 0, gl)
         if meta.any_cat:
-            # categorical: int truncation compare, matching the host
-            # walk (tree.py predict_leaf_index) and _walk_one_tree
+            # categorical: int truncation compare with the host walk's
+            # explicit finite mask (tree.py predict_leaf_index), same
+            # as predict_ensemble_leaf — value and leaf kernels must
+            # agree on every routing decision, NaN rows included
+            finite = jnp.isfinite(v)
+            vi = jnp.where(finite, v, -1.0).astype(jnp.int32)
             gl = jnp.where(rec[..., 2] > 0,
-                           v.astype(jnp.int32) == t.astype(jnp.int32), gl)
+                           finite & (vi == t.astype(jnp.int32)), gl)
         nxt = jnp.where(gl, rec[..., 3], rec[..., 4]).astype(jnp.int32)
         return jnp.where(node >= 0, nxt, node)
 
@@ -483,20 +489,15 @@ def predict_ensemble_any(stack, X: jax.Array, *,
     return predict_ensemble(stack, X, meta=meta)
 
 
-@functools.partial(jax.jit, static_argnames=("meta",))
-def predict_ensemble_binned(stack: EnsembleStack, bins_t: jax.Array,
-                            feat_tbl: Optional[jax.Array] = None, *,
-                            meta: EnsembleMeta) -> jax.Array:
-    """Raw per-class scores over the BINNED store — [K, N] f32.
-
-    bins_t: [N+1, C] int store bins (the ScoreUpdater layout — C is
-    original features, or bundled columns with `feat_tbl`).  Compares
-    stay integer end to end (bin codes vs in-bin thresholds), so replay
-    skips float thresholding entirely.  `feat_tbl` ([5, F]: col, offset,
-    default, nslots, packed) is the EFB packed-slot remap of
-    score_updater._walk_step: trees speak original (feature, bin) space,
-    the store speaks bundle space.
-    """
+def _walk_binned_nodes(stack: EnsembleStack, bins_t: jax.Array,
+                       feat_tbl: Optional[jax.Array], meta: EnsembleMeta
+                       ) -> jax.Array:
+    """The binned ensemble walk itself: parked node per (tree, row) —
+    [T, N] int32, leaves encoded as ~leaf.  Shared by the score replay
+    (`predict_ensemble_binned`) and the leaf-index router
+    (`predict_ensemble_leaf_binned`) so the two can never disagree on a
+    routing decision — the online refit subsystem depends on routing
+    rows to exactly the leaves whose values the replay sums."""
     N = bins_t.shape[0] - 1
     bins_nt = bins_t[:N].astype(jnp.int32)
     T = stack.nodes.shape[0]
@@ -529,5 +530,77 @@ def predict_ensemble_binned(stack: EnsembleStack, bins_t: jax.Array,
         nxt = jnp.where(gl, rec[..., 3], rec[..., 4])
         return jnp.where(node >= 0, nxt, node)
 
-    node = jax.lax.fori_loop(0, meta.depth, step, node)
+    return jax.lax.fori_loop(0, meta.depth, step, node)
+
+
+@functools.partial(jax.jit, static_argnames=("meta",))
+def predict_ensemble_binned(stack: EnsembleStack, bins_t: jax.Array,
+                            feat_tbl: Optional[jax.Array] = None, *,
+                            meta: EnsembleMeta) -> jax.Array:
+    """Raw per-class scores over the BINNED store — [K, N] f32.
+
+    bins_t: [N+1, C] int store bins (the ScoreUpdater layout — C is
+    original features, or bundled columns with `feat_tbl`).  Compares
+    stay integer end to end (bin codes vs in-bin thresholds), so replay
+    skips float thresholding entirely.  `feat_tbl` ([5, F]: col, offset,
+    default, nslots, packed) is the EFB packed-slot remap of
+    score_updater._walk_step: trees speak original (feature, bin) space,
+    the store speaks bundle space.
+    """
+    node = _walk_binned_nodes(stack, bins_t, feat_tbl, meta)
     return _leaf_sums(stack, node, meta.num_class)
+
+
+@functools.partial(jax.jit, static_argnames=("meta",))
+def predict_ensemble_leaf_binned(stack: EnsembleStack, bins_t: jax.Array,
+                                 feat_tbl: Optional[jax.Array] = None, *,
+                                 meta: EnsembleMeta) -> jax.Array:
+    """Per-tree leaf index over the BINNED store — [T, N] int32.
+
+    The online-refit router: exactly the walk `predict_ensemble_binned`
+    sums values over, returning the parked leaf instead (stumps park at
+    leaf 0).  Integer bin compares end to end, so routing is exact on
+    any store the trees were rebinned to.
+    """
+    node = _walk_binned_nodes(stack, bins_t, feat_tbl, meta)
+    return jnp.where(node < 0, ~node, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("meta",))
+def predict_ensemble_leaf(stack: EnsembleStack, X: jax.Array, *,
+                          meta: EnsembleMeta) -> jax.Array:
+    """Per-tree leaf index over RAW feature values — [T, N] int32.
+
+    The tensorized `pred_leaf` kernel.  Decision parity is with the
+    HOST walk (tree.py predict_leaf_index), which is the `walk` kernel
+    for leaf output: numerical ``v <= t`` (f32 — NaN falls right),
+    categorical compares via the host's explicit finite mask
+    (non-finite NEVER matches a category; a bare int cast of NaN is
+    backend-defined and silently diverges from the host on NaN rows —
+    the divergence the walk/tensorized parity test pins down).
+    """
+    Xf = X.astype(jnp.float32)
+    T = stack.nodes.shape[0]
+    N = Xf.shape[0]
+    rows = jnp.arange(N)[None, :]
+    node = jnp.broadcast_to(stack.root[:, None], (T, N))
+
+    def step(_, node):
+        safe = jnp.maximum(node, 0)
+        rec = jnp.take_along_axis(stack.nodes, safe[:, :, None], axis=1)
+        f = rec[..., 0].astype(jnp.int32)
+        v = Xf[rows, f]                                  # [T, N]
+        t = rec[..., 1]
+        gl = v <= t
+        if meta.any_default_left:
+            gl = jnp.where(jnp.isnan(v), rec[..., 5] > 0, gl)
+        if meta.any_cat:
+            finite = jnp.isfinite(v)
+            vi = jnp.where(finite, v, -1.0).astype(jnp.int32)
+            gl = jnp.where(rec[..., 2] > 0,
+                           finite & (vi == t.astype(jnp.int32)), gl)
+        nxt = jnp.where(gl, rec[..., 3], rec[..., 4]).astype(jnp.int32)
+        return jnp.where(node >= 0, nxt, node)
+
+    node = jax.lax.fori_loop(0, meta.depth, step, node)
+    return jnp.where(node < 0, ~node, 0)

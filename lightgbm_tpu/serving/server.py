@@ -171,11 +171,25 @@ class PredictionServer:
         self._stop = threading.Event()
         self._threads = []
 
+    @staticmethod
+    def _model_meta(model_path: str):
+        """The online trainer's ``<model>.meta.json`` sidecar (generation
+        provenance: refresh mode, rows, publish time), or None when the
+        model is not published by an online loop."""
+        try:
+            with open(model_path + ".meta.json") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
     def stats(self) -> dict:
         runtime = self.registry.current()
         return {
             "generation": self.registry.generation,
             "model_path": self.registry.model_path,
+            # generation metadata published by the task=online trainer
+            # (lightgbm_tpu/online/trainer.py), when this model is one
+            "online": self._model_meta(self.registry.model_path),
             "requests": profiling.counter_value("serve.requests"),
             "rows": profiling.counter_value("serve.rows"),
             "batches": profiling.counter_value("serve.batches"),

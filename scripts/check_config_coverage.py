@@ -40,8 +40,6 @@ ALLOWLIST = {
     "gpu_use_dp": "OpenCL precision dial; histogram_dtype is the analog",
     "time_out": "socket-network timeout; collectives have no knob here",
     "output_freq": "CLI logging cadence not yet wired",
-    # parsed by the CLI bootstrap before Config exists
-    "config_file": "consumed by parse_cli_args pre-Config",
     # declared TPU knobs awaiting implementation
     "hist_dtype": "accumulation dtype override not yet implemented",
     "hist_input_dtype": "superseded by histogram_dtype; kept for compat",

@@ -125,6 +125,32 @@ def main():
         "server still admits (the runtime chunks it), bounding the "
         "queue at cap + one request.  `0` = unbounded.",
         "",
+        "## Online learning",
+        "",
+        "- `refit_decay_rate` (default `0.9`, aliases `decay_rate`, "
+        "`refit_decay`): leaf-value blending weight for refit — "
+        "`new = decay * old + (1 - decay) * newton_output` (reference "
+        "`refit_decay_rate` semantics).  `0` replaces leaf values "
+        "outright (refitting on the original training data then "
+        "reproduces them), `1` freezes the model.  Used by "
+        "`Booster.refit`, `task=refit`, and the `task=online` daemon.  "
+        "See `docs/Online-Learning.md`.",
+        "- `refit_min_rows` (default `20`, aliases `min_refit_rows`, "
+        "`refit_min_data`): leaves routed fewer fresh rows than this "
+        "keep their old value — a starved leaf's Newton step is noise, "
+        "and a zero-hessian leaf would divide by zero.  Floors at 1.",
+        "- `online_trigger_rows` (default `4096`, aliases "
+        "`online_trigger`, `trigger_rows`): the `task=online` daemon "
+        "refreshes the model once this many new labeled traffic rows "
+        "accumulated in the streaming window; it also seeds the "
+        "window's store-capacity tier.",
+        "- `online_mode` (default `'refit'`, alias `refresh_mode`): "
+        "what a refresh does.  `refit` reweights the existing tree "
+        "structures' leaves on the window (~one ensemble traversal "
+        "plus one scan — no tree growth, no retraces at steady "
+        "state); `continue` appends `num_iterations` fresh trees via "
+        "continued boosting (`reset_training_data` replay).",
+        "",
         "## Exclusive Feature Bundling",
         "",
         "- `enable_bundle` (default `True`, aliases `efb`, `bundle`): "

@@ -54,6 +54,11 @@ stage profile env BENCH_SANITIZE=1 python scripts/profile_hotpath.py || exit 1
 # north-star model shape, gated on the sanitizer (0 retraces / 0
 # implicit transfers at steady state — fails AFTER its JSON prints)
 stage bench_serve env BENCH_SANITIZE=1 SERVE_BENCH_SECONDS=10 SERVE_BENCH_OUT=.bench/bench_serve.json python scripts/bench_serve.py || exit 1
+# online-learning refresh loop at the reduced north-star shape:
+# refit-vs-retrain wall-clock (>= 10x gate) + AUC-after-drift recovery,
+# steady-state refits under the sanitizer (0 retraces / 0 implicit
+# transfers per refresh) — refreshes the committed artifact
+stage bench_online env BENCH_SANITIZE=1 BENCH_ONLINE_OUT=bench_online_measured.json python scripts/bench_online.py || exit 1
 stage bench_narrow_off env LGBT_NARROW_ONEHOT=0 BENCH_ITERS=12 python bench.py || exit 1
 stage bench_part_off   env LGBT_FUSED_PARTITION=0 BENCH_ITERS=12 python bench.py || exit 1
 # 2. the 63-bin variant (VERDICT #2: reference accelerator sweet spot)

@@ -687,6 +687,13 @@ EXPORT int LGBM_BoosterUpdateOneIterCustom(void* handle, const float* grad,
   return 0;
 }
 
+EXPORT int LGBM_BoosterRefit(void* handle, const int* leaf_preds, int nrow,
+                             int ncol) {
+  Gil gil;
+  return void_out(
+      call_method(handle, "refit", "(Kii)", addr(leaf_preds), nrow, ncol));
+}
+
 EXPORT int LGBM_BoosterRollbackOneIter(void* handle) {
   Gil gil;
   PyObject* b = PyObject_GetAttrString(reinterpret_cast<PyObject*>(handle),

@@ -1,0 +1,740 @@
+"""Online-learning subsystem (lightgbm_tpu/online/): streaming dataset
+ingestion, leaf-value refit from labeled traffic, continued boosting,
+and continuous model publishing into the serving registry.
+
+Parity notes pinned by these tests:
+
+- Leaf ROUTING is exact: the binned ensemble router returns bitwise the
+  host walk's leaf indices (the refit kernel depends on it).
+- Leaf VALUES refit on the original training data with decay 0
+  reproduce training bitwise when the gradients are dyadic (training's
+  histogram sums are then order-independent), and to <= 1e-6 absolute
+  otherwise.  The residual is TRAINING's own noise: its per-leaf
+  gradient sums come from f32 histogram cumsums + parent-minus-sibling
+  chains whose accumulation order the one-pass refit sum cannot (and
+  should not) replay — measured ~1e-5 RELATIVE on leaves with heavy
+  gradient cancellation, which is also the floor of an exact f64
+  recomputation (see docs/Online-Learning.md).
+"""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import config_from_params
+from lightgbm_tpu.dataset import Dataset as RawDataset, row_capacity_tier
+from lightgbm_tpu.online import (LeafRefitter, OnlineTrainer, TrafficLog,
+                                 append_traffic, refit_gbdt)
+
+pytestmark = pytest.mark.quick
+
+
+def _synth(n=1500, f=10, seed=5):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, f)
+    w = rng.randn(f)
+    z = X @ w
+    y = (z > np.median(z)).astype(np.float64)
+    return X, y
+
+
+def _train(X, y, params, rounds=6):
+    p = {"verbose": -1, "min_data_in_leaf": 5, **params}
+    return lgb.train(p, lgb.Dataset(X, y), num_boost_round=rounds)
+
+
+def _leaf_values(bst):
+    return [np.asarray(t.leaf_value[: t.num_leaves]).copy()
+            for t in bst._gbdt.models]
+
+
+# ---------------------------------------------------------------------------
+# streaming ingestion: Dataset append path
+# ---------------------------------------------------------------------------
+
+
+def test_row_capacity_tier_ladder():
+    assert row_capacity_tier(1) == 1024
+    assert row_capacity_tier(1024) == 1024
+    assert row_capacity_tier(1025) == 2048
+    assert row_capacity_tier(5000) == 8192
+    # growth from an existing tier doubles
+    assert row_capacity_tier(3000, base=2048) == 4096
+
+
+def test_streaming_append_matches_batch_binning():
+    X, y = _synth(2000)
+    cfg = config_from_params({"verbose": -1})
+    base = RawDataset(X[:1200], y[:1200].astype(np.float32), cfg)
+    s = RawDataset.streaming_from(base, cfg)
+    for lo in range(1200, 2000, 171):       # ragged chunks
+        hi = min(lo + 171, 2000)
+        s.append_rows(X[lo:hi], y[lo:hi])
+    batch = RawDataset(X[1200:2000], y[1200:2000].astype(np.float32), cfg,
+                       reference=base)
+    assert s.num_data == 800
+    np.testing.assert_array_equal(s.bins[:, :800], batch.bins)
+    np.testing.assert_array_equal(s.metadata.label, y[1200:2000])
+    # capacity tier is a power-of-two ladder; slack rows hold bin 0
+    assert s.row_capacity == 1024
+    assert not s.bins[:, 800:].any()
+
+
+def test_streaming_append_grows_tiers_and_keeps_rows():
+    X, y = _synth(3000)
+    cfg = config_from_params({"verbose": -1})
+    base = RawDataset(X[:500], y[:500].astype(np.float32), cfg)
+    s = RawDataset.streaming_from(base, cfg)
+    s.append_rows(X[:1000], y[:1000])
+    assert s.row_capacity == 1024
+    first = s.bins[:, :1000].copy()
+    s.append_rows(X[1000:2500], y[1000:2500])   # crosses 1024 and 2048
+    assert s.row_capacity == 4096
+    np.testing.assert_array_equal(s.bins[:, :1000], first)
+    assert s.num_data == 2500
+
+
+def test_streaming_reset_keeps_capacity_tier():
+    X, y = _synth(1500)
+    cfg = config_from_params({"verbose": -1})
+    base = RawDataset(X[:500], y[:500].astype(np.float32), cfg)
+    s = RawDataset.streaming_from(base, cfg)
+    s.append_rows(X, y)
+    cap = s.row_capacity
+    assert cap == 2048
+    s.reset_rows()
+    assert s.num_data == 0 and s.row_capacity == cap
+    assert not s.bins.any()
+    assert s.metadata.label.size == 0
+
+
+def test_streaming_append_validation():
+    X, y = _synth(600)
+    cfg = config_from_params({"verbose": -1})
+    base = RawDataset(X[:300], y[:300].astype(np.float32), cfg)
+    s = RawDataset.streaming_from(base, cfg)
+    with pytest.raises(ValueError):
+        s.append_rows(X[:10, :5], y[:10])           # wrong width
+    s.append_rows(X[:10], y[:10])
+    with pytest.raises(ValueError):
+        s.append_rows(X[10:20], y[10:15])           # label length mismatch
+    with pytest.raises(ValueError):
+        s.append_rows(X[10:20])                     # unlabeled into labeled
+    # weights: missing chunks backfill with ones
+    s.append_rows(X[10:20], y[10:20], weight=np.full(10, 2.0))
+    assert s.metadata.weights.shape == (20,)
+    np.testing.assert_array_equal(s.metadata.weights[:10], 1.0)
+    np.testing.assert_array_equal(s.metadata.weights[10:], 2.0)
+
+
+def test_streaming_compacted_trains_like_batch():
+    X, y = _synth(900)
+    cfg = config_from_params(
+        {"verbose": -1, "objective": "binary", "num_leaves": 15,
+         "min_data_in_leaf": 5, "num_iterations": 3})
+    base = RawDataset(X, y.astype(np.float32), cfg)
+    s = RawDataset.streaming_from(base, cfg)
+    s.append_rows(X, y)
+    c = s.compacted()
+    assert c.num_data == 900 and c.row_capacity == 900
+    np.testing.assert_array_equal(c.bins, base.bins)
+    assert c.metadata is s.metadata
+
+
+# ---------------------------------------------------------------------------
+# labeled-traffic JSONL reader
+# ---------------------------------------------------------------------------
+
+
+def test_traffic_log_roundtrip_and_shorthand(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    X, y = _synth(40, f=4)
+    append_traffic(path, X[:20], y[:20])
+    with open(path, "a") as f:                      # array shorthand rows
+        for i in range(20, 30):
+            f.write(json.dumps([y[i]] + [float(v) for v in X[i]]) + "\n")
+    tl = TrafficLog(path)
+    got = tl.read_new()
+    assert got is not None
+    Xg, yg, wg = got
+    np.testing.assert_allclose(Xg, X[:30])
+    np.testing.assert_allclose(yg, y[:30])
+    assert wg is None
+    assert tl.read_new() is None                    # nothing new
+    append_traffic(path, X[30:], y[30:], weight=np.full(10, 3.0))
+    Xg, yg, wg = tl.read_new()
+    assert len(Xg) == 10 and wg is not None
+    np.testing.assert_array_equal(wg, 3.0)
+    assert tl.rows_read == 40
+
+
+def test_traffic_log_torn_tail_and_bad_lines(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    tl = TrafficLog(path)
+    assert tl.read_new() is None                    # missing file
+    with open(path, "w") as f:
+        f.write('{"features": [1.0, 2.0], "label": 1}\n')
+        f.write('this is not json\n')
+        f.write('{"features": [3.0], "label": 0}\n')   # width mismatch
+        f.write('{"features": [3.0, 4.0], "label"')    # torn tail
+    Xg, yg, _ = tl.read_new()
+    assert len(Xg) == 1 and tl.bad_lines == 2
+    assert tl.read_new() is None                    # tail still torn
+    with open(path, "a") as f:                      # newline lands
+        f.write(': 0}\n')
+    Xg, yg, _ = tl.read_new()
+    assert len(Xg) == 1 and float(yg[0]) == 0.0
+
+
+def test_traffic_log_short_first_line_cannot_poison_batch(tmp_path):
+    # a complete-but-short FIRST line must lose only itself — with the
+    # width pinned to the model's feature count it can never become
+    # the yardstick that rejects every valid row behind it (which
+    # would wedge the daemon's pre-freeze buffer forever)
+    path = str(tmp_path / "t.jsonl")
+    with open(path, "w") as f:
+        f.write('{"features": [1.0, 2.0], "label": 1}\n')   # 2 of 4
+        for i in range(3):
+            f.write(json.dumps({"features": [float(i)] * 4,
+                                "label": 0}) + "\n")
+    tl = TrafficLog(path, expected_features=4)
+    Xg, yg, _ = tl.read_new()
+    assert Xg.shape == (3, 4) and tl.bad_lines == 1
+    # unpinned: the width locks to the first good line EVER, not per
+    # batch, so a later short line still cannot re-anchor it
+    tl2 = TrafficLog(path)
+    Xg2, _, _ = tl2.read_new()
+    assert Xg2.shape == (1, 2)                      # legacy first-line lock
+    with open(path, "a") as f:
+        f.write(json.dumps({"features": [9.0, 9.0], "label": 1}) + "\n")
+        f.write(json.dumps({"features": [7.0] * 4, "label": 1}) + "\n")
+    Xg2, _, _ = tl2.read_new()
+    assert Xg2.shape == (1, 2) and float(Xg2[0, 0]) == 9.0
+
+
+def test_traffic_log_bounded_poll_drains_backlog(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    X, y = _synth(30, f=4)
+    append_traffic(path, X, y)
+    tl = TrafficLog(path, expected_features=4, max_poll_bytes=256)
+    rows = 0
+    for _ in range(100):
+        got = tl.read_new()
+        if got is not None:
+            rows += len(got[0])
+    assert rows == 30 and tl.bad_lines == 0
+    # one line larger than the cap is skipped, never wedges the reader
+    with open(path, "a") as f:
+        f.write(json.dumps({"features": [1.0] * 200, "label": 1}) + "\n")
+    append_traffic(path, X[:2], y[:2])
+    rows2 = 0
+    for _ in range(100):
+        got = tl.read_new()
+        if got is not None:
+            rows2 += len(got[0])
+    assert rows2 == 2 and tl.bad_lines >= 1
+
+
+def test_traffic_log_truncation_restarts(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    X, y = _synth(8, f=3)
+    append_traffic(path, X[:6], y[:6])
+    tl = TrafficLog(path)
+    assert len(tl.read_new()[0]) == 6
+    with open(path, "w") as f:                      # rotation: shorter file
+        pass
+    append_traffic(path, X[6:], y[6:])
+    assert len(tl.read_new()[0]) == 2
+
+
+# ---------------------------------------------------------------------------
+# leaf-index routing parity (walk vs tensorized) — the refit router
+# ---------------------------------------------------------------------------
+
+
+def _pred_leaf(params, X, y, data, kernel, rounds=6):
+    p = dict(params, predict_kernel=kernel)
+    bst = _train(X, y, p, rounds)
+    os.environ["LIGHTGBM_TPU_DEVICE_PREDICT"] = (
+        "1" if kernel == "tensorized" else "0")
+    try:
+        return bst.predict(data, pred_leaf=True)
+    finally:
+        os.environ.pop("LIGHTGBM_TPU_DEVICE_PREDICT", None)
+
+
+@pytest.mark.parametrize("objective", ["binary", "multiclass"])
+def test_pred_leaf_walk_tensorized_parity(objective):
+    X, y = _synth(700, f=12, seed=11)
+    params = {"objective": objective, "num_leaves": 15}
+    if objective == "multiclass":
+        params["num_class"] = 3
+        y = (np.abs(X[:, 0] * 7) % 3).astype(np.float64)
+    Xn = X.copy()
+    Xn[::7, 3] = np.nan                             # NaN routing rows
+    Xn[::11, 0] = np.nan
+    for data in (X, Xn):
+        lw = _pred_leaf(params, X, y, data, "walk")
+        lt = _pred_leaf(params, X, y, data, "tensorized")
+        np.testing.assert_array_equal(lw, lt)
+        assert lw.shape[1] == lt.shape[1] > 0
+
+
+def test_pred_leaf_parity_categorical():
+    rng = np.random.RandomState(3)
+    X = rng.rand(600, 6)
+    X[:, 2] = rng.randint(0, 5, 600)                # categorical column
+    y = ((X[:, 0] > 0.5) ^ (X[:, 2] > 2)).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 15,
+              "categorical_feature": [2]}
+    lw = _pred_leaf(params, X, y, X, "walk")
+    lt = _pred_leaf(params, X, y, X, "tensorized")
+    np.testing.assert_array_equal(lw, lt)
+
+
+def test_binned_router_matches_host_walk():
+    """The refit router (predict_ensemble_leaf_binned over the store)
+    must route every row to exactly the host walk's leaf."""
+    import jax
+    from lightgbm_tpu.learner.common import sentinel_bins_t
+    from lightgbm_tpu.ops.predict import predict_ensemble_leaf_binned
+    X, y = _synth(800)
+    bst = _train(X, y, {"objective": "binary", "num_leaves": 31}, 8)
+    g = bst._gbdt
+    host = np.stack([t.predict_leaf_index(X) for t in g.models])
+    cfg = config_from_params({"verbose": -1})
+    inner = RawDataset(X, y.astype(np.float32), cfg)
+    r = LeafRefitter(g, inner)
+    r._ensure_router()              # the stack builds lazily
+    bins_t = jax.device_put(sentinel_bins_t(inner))
+    dev = np.asarray(jax.device_get(predict_ensemble_leaf_binned(
+        r._stack, bins_t, r._feat_tbl, meta=r._meta)))[:, : len(X)]
+    np.testing.assert_array_equal(dev, host)
+
+
+# ---------------------------------------------------------------------------
+# leaf-value refit parity
+# ---------------------------------------------------------------------------
+
+
+def test_refit_dyadic_gradients_bitwise():
+    """Dyadic labels (k/128) + lr 0.5 + one iteration: every gradient,
+    histogram sum, and shrinkage product is exact in f32, so training's
+    accumulation order is irrelevant and refit reproduces the leaf
+    values BITWISE."""
+    rng = np.random.RandomState(3)
+    X = rng.randn(2000, 10)
+    y = (rng.randint(0, 256, 2000) / 128.0).astype(np.float64)
+    params = {"objective": "regression", "num_leaves": 31,
+              "learning_rate": 0.5, "boost_from_average": False,
+              "verbose": -1, "min_data_in_leaf": 20}
+    bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=1)
+    orig = _leaf_values(bst)
+    inner = RawDataset(X, y.astype(np.float32), config_from_params(params))
+    refit_gbdt(bst._gbdt, inner, decay_rate=0.0, min_rows=1)
+    for t, o in zip(bst._gbdt.models, orig):
+        np.testing.assert_array_equal(
+            np.asarray(t.leaf_value[: t.num_leaves]), o)
+
+
+@pytest.mark.parametrize("objective,rounds", [("binary", 5),
+                                              ("regression", 8)])
+def test_refit_reproduces_training_leaves(objective, rounds):
+    """decay 0 refit on the original training data reproduces the
+    trained leaf values to <= 1e-6 absolute (the residual is training's
+    own f32 histogram accumulation noise — see module docstring)."""
+    rng = np.random.RandomState(7)
+    X = rng.randn(512, 8)
+    if objective == "binary":
+        y = (X[:, 0] > 0).astype(np.float64)
+    else:
+        y = np.sin(X[:, 0]) + 0.3 * X[:, 1] + 0.1 * rng.randn(512)
+    params = {"objective": objective, "num_leaves": 7, "verbose": -1,
+              "min_data_in_leaf": 20, "learning_rate": 0.1}
+    bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=rounds)
+    orig = _leaf_values(bst)
+    g = bst._gbdt
+    inner = RawDataset(X, y.astype(np.float32), config_from_params(params))
+    stats = refit_gbdt(g, inner, decay_rate=0.0, min_rows=1)
+    assert stats["rows"] == 512
+    for i, (t, o) in enumerate(zip(g.models, orig)):
+        got = np.asarray(t.leaf_value[: t.num_leaves])
+        assert np.abs(got - o).max() <= 1e-6, (i, got, o)
+
+
+def test_refit_decay_one_freezes_values():
+    X, y = _synth(600)
+    bst = _train(X, y, {"objective": "binary", "num_leaves": 15})
+    orig = _leaf_values(bst)
+    inner = RawDataset(X, (1.0 - y).astype(np.float32),
+                       config_from_params({"verbose": -1}))
+    refit_gbdt(bst._gbdt, inner, decay_rate=1.0, min_rows=1)
+    for t, o in zip(bst._gbdt.models, orig):
+        np.testing.assert_array_equal(
+            np.asarray(t.leaf_value[: t.num_leaves]), o)
+
+
+def test_refit_min_rows_guard_keeps_starved_leaves():
+    X, y = _synth(600)
+    bst = _train(X, y, {"objective": "binary", "num_leaves": 15})
+    orig = _leaf_values(bst)
+    inner = RawDataset(X, (1.0 - y).astype(np.float32),
+                       config_from_params({"verbose": -1}))
+    # min_rows above the window size: every leaf is starved -> frozen
+    refit_gbdt(bst._gbdt, inner, decay_rate=0.0, min_rows=10_000)
+    for t, o in zip(bst._gbdt.models, orig):
+        np.testing.assert_array_equal(
+            np.asarray(t.leaf_value[: t.num_leaves]), o)
+
+
+def test_refit_zero_weight_rows_keep_values():
+    # a leaf whose fresh rows all carry weight 0 has zero hessian mass:
+    # it must keep its old value, never take the 0/0 Newton step and
+    # publish NaN (training's min_sum_hessian_in_leaf invariant)
+    X, y = _synth(800, seed=61)
+    bst = _train(X, y, {"objective": "binary", "num_leaves": 15,
+                        "refit_min_rows": 1}, 4)
+    before = _leaf_values(bst)
+    rb = bst.refit(X, y, decay_rate=0.0, weight=np.zeros(len(X)))
+    after = _leaf_values(rb)
+    for b, a in zip(before, after):
+        assert np.all(np.isfinite(a))
+        np.testing.assert_array_equal(a, b)
+
+
+def test_streaming_compacted_at_capacity_survives_reset():
+    # at num_data == capacity the trimming slice covers the whole
+    # store; compacted() must still COPY, or reset_rows() would zero
+    # the "copy" in place
+    X, y = _synth(1024, f=6, seed=71)
+    cfg = config_from_params({"verbose": -1, "objective": "binary"})
+    base = RawDataset(X, y.astype(np.float32), cfg)
+    s = RawDataset.streaming_from(base, cfg, capacity=1024)
+    s.append_rows(X, y)
+    assert s.num_data == s.row_capacity == 1024
+    c = s.compacted()
+    snap = c.bins.copy()
+    s.reset_rows()
+    assert snap.any()
+    np.testing.assert_array_equal(c.bins, snap)
+
+
+def test_refit_freezes_boost_from_average_tree():
+    rng = np.random.RandomState(5)
+    X = rng.randn(600, 6)
+    y = X[:, 0] + 5.0 + 0.1 * rng.randn(600)        # non-zero average
+    params = {"objective": "regression", "num_leaves": 15, "verbose": -1,
+              "min_data_in_leaf": 5}
+    bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=3)
+    g = bst._gbdt
+    assert g.boost_from_average_used
+    orig = _leaf_values(bst)
+    inner = RawDataset(X, (y - 2.0).astype(np.float32),
+                       config_from_params(params))
+    stats = refit_gbdt(g, inner, decay_rate=0.0, min_rows=1)
+    # the init tree keeps its baseline; the fitted trees refit
+    np.testing.assert_array_equal(
+        np.asarray(g.models[0].leaf_value[: g.models[0].num_leaves]),
+        orig[0])
+    assert stats["trees_refit"] == stats["trees"] - 1
+
+
+def test_refit_requires_labels_and_rows():
+    X, y = _synth(300)
+    bst = _train(X, y, {"objective": "binary", "num_leaves": 7})
+    cfg = config_from_params({"verbose": -1})
+    base = RawDataset(X, y.astype(np.float32), cfg)
+    s = RawDataset.streaming_from(base, cfg)
+    r = LeafRefitter(bst._gbdt, s)
+    with pytest.raises(lgb.LightGBMError):
+        r.refit()                                   # zero rows
+    # a structure change invalidates the compiled refitter
+    s.append_rows(X[:100], y[:100])
+    bst._gbdt.models.append(bst._gbdt.models[-1])
+    try:
+        with pytest.raises(lgb.LightGBMError):
+            r.refit()
+    finally:
+        bst._gbdt.models.pop()
+
+
+# ---------------------------------------------------------------------------
+# Booster.refit / C API refit
+# ---------------------------------------------------------------------------
+
+
+def test_booster_refit_api_contract():
+    X, y = _synth(800)
+    bst = _train(X, y, {"objective": "binary", "num_leaves": 15})
+    p0 = bst.predict(X)
+    flipped = 1.0 - y
+    nb = bst.refit(X, flipped, decay_rate=0.0, refit_min_rows=1)
+    assert nb is not bst
+    np.testing.assert_array_equal(bst.predict(X), p0)   # self untouched
+    p1 = nb.predict(X)
+    # refit on inverted labels must invert the ranking direction
+    before = p0[flipped > 0.5].mean() - p0[flipped < 0.5].mean()
+    after = p1[flipped > 0.5].mean() - p1[flipped < 0.5].mean()
+    assert before < 0 < after
+    # decay 1.0 keeps the old predictions exactly
+    same = bst.refit(X, flipped, decay_rate=1.0)
+    np.testing.assert_array_equal(same.predict(X), p0)
+
+
+def test_booster_refit_needs_labels():
+    X, y = _synth(200)
+    bst = _train(X, y, {"objective": "binary", "num_leaves": 7})
+    with pytest.raises(ValueError):
+        bst.refit(X, None)
+
+
+def test_capi_refit_leaf_pred_contract():
+    from lightgbm_tpu import capi
+    X, y = _synth(500, seed=17)
+    params = ("objective=binary verbose=-1 num_leaves=15 "
+              "min_data_in_leaf=5 refit_decay_rate=0.0 refit_min_rows=1")
+    Xc = np.ascontiguousarray(X)
+    ds = capi.dataset_from_mat(Xc.ctypes.data, 1, len(X), X.shape[1], 1,
+                               params, None)
+    lab = y.astype(np.float32)
+    ds.set_field("label", lab.ctypes.data, len(y), 0)
+    bst = capi.CApiBooster.create(ds, params)
+    for _ in range(4):
+        bst.update()
+    g = bst.booster._gbdt
+    orig = _leaf_values(bst.booster)
+    leaf = np.ascontiguousarray(
+        bst.booster.predict(X, pred_leaf=True).astype(np.int32))
+    flipped = (1.0 - y).astype(np.float32)
+    ds.inner.metadata.label = flipped
+    bst.refit(leaf.ctypes.data, leaf.shape[0], leaf.shape[1])
+    changed = [not np.array_equal(
+        np.asarray(t.leaf_value[: t.num_leaves]), o)
+        for t, o in zip(g.models, orig)]
+    assert any(changed)
+    # shape mismatch is rejected
+    with pytest.raises(ValueError):
+        bst.refit(leaf.ctypes.data, leaf.shape[0] - 1, leaf.shape[1])
+
+
+# ---------------------------------------------------------------------------
+# continued training (init_model)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("via", ["file", "memory"])
+def test_init_model_continuation_roundtrip(via, tmp_path):
+    X, y = _synth(1200, seed=9)
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 15,
+              "min_data_in_leaf": 5}
+    base = lgb.train(params, lgb.Dataset(X[:800], y[:800]),
+                     num_boost_round=5)
+    if via == "file":
+        mp = str(tmp_path / "m.txt")
+        base.save_model(mp)
+        init = mp
+    else:
+        init = base
+    evals = {}
+    cont = lgb.train(params, lgb.Dataset(X[800:], y[800:]),
+                     num_boost_round=4, init_model=init,
+                     valid_sets=[lgb.Dataset(X[800:], y[800:])],
+                     evals_result=evals, verbose_eval=False)
+    n0 = base.num_trees()
+    assert cont.num_trees() == n0 + 4
+    # the input model's trees ride along bitwise
+    for a, b in zip(cont._gbdt.models[:n0], base._gbdt.models[:n0]):
+        np.testing.assert_array_equal(np.asarray(a.leaf_value),
+                                      np.asarray(b.leaf_value))
+    # and training on the continuation set improves its metric monotonically
+    vals = next(iter(next(iter(evals.values())).values()))
+    assert len(vals) == 4
+    assert all(vals[i + 1] <= vals[i] for i in range(len(vals) - 1)), vals
+
+
+# ---------------------------------------------------------------------------
+# OnlineTrainer daemon
+# ---------------------------------------------------------------------------
+
+
+def _online_setup(tmp_path, mode="refit", trigger=256, extra=None):
+    X, y = _synth(1600, seed=21)
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 15,
+              "min_data_in_leaf": 5, "online_mode": mode,
+              "online_trigger_rows": trigger, "refit_decay_rate": 0.0,
+              "refit_min_rows": 1, **(extra or {})}
+    bst = lgb.train(params, lgb.Dataset(X[:1000], y[:1000]),
+                    num_boost_round=5)
+    traffic = str(tmp_path / "traffic.jsonl")
+    pub = str(tmp_path / "pub.txt")
+    tr = OnlineTrainer(bst, traffic, pub, config=config_from_params(params))
+    return tr, bst, X, y, traffic, pub
+
+
+def test_online_trainer_refit_cycle_and_sidecar(tmp_path):
+    tr, bst, X, y, traffic, pub = _online_setup(tmp_path)
+    flipped = 1.0 - y
+    append_traffic(traffic, X[1000:1100], flipped[1000:1100])
+    assert tr.poll_once() is False                  # below trigger
+    assert tr.pending_rows() == 100
+    append_traffic(traffic, X[1100:1400], flipped[1100:1400])
+    assert tr.poll_once() is True
+    assert tr.generation == 1 and os.path.exists(pub)
+    meta = json.load(open(pub + ".meta.json"))
+    assert meta["generation"] == 1 and meta["mode"] == "refit"
+    assert meta["rows"] == 400 and meta["trigger_rows"] == 256
+    assert meta["refresh_seconds"] >= 0
+    # the window resets after a publish; the refitter is reused
+    assert tr.pending_rows() == 0
+    append_traffic(traffic, X[1400:], flipped[1400:])
+    assert tr.poll_once() is False                  # 200 < trigger
+    tr.refresh()                                    # explicit flush
+    assert tr.generation == 2
+    # published model adapted to the flipped labels
+    nb = lgb.Booster(params={"verbose": -1}, model_file=pub)
+    p = nb.predict(X[:1000])
+    assert p[flipped[:1000] > 0.5].mean() > p[flipped[:1000] < 0.5].mean()
+
+
+def test_online_trainer_continue_mode_appends_trees(tmp_path):
+    tr, bst, X, y, traffic, pub = _online_setup(
+        tmp_path, mode="continue", extra={"num_iterations": 2})
+    n0 = bst.num_trees()
+    append_traffic(traffic, X[1000:1400], y[1000:1400])
+    assert tr.poll_once() is True
+    meta = json.load(open(pub + ".meta.json"))
+    assert meta["mode"] == "continue"
+    assert meta["trees_before"] == n0
+    nb = lgb.Booster(params={"verbose": -1}, model_file=pub)
+    assert nb.num_trees() == n0 + 2
+
+
+def test_online_trainer_survives_bad_traffic(tmp_path):
+    tr, bst, X, y, traffic, pub = _online_setup(tmp_path)
+    with open(traffic, "w") as f:
+        f.write("garbage line\n")
+        f.write('{"features": "nope", "label": 1}\n')
+    assert tr.poll_once() is False
+    assert tr.traffic.bad_lines == 2
+    append_traffic(traffic, X[1000:1300], y[1000:1300])
+    assert tr.poll_once() is True                   # recovered
+
+
+def test_online_task_config_validation():
+    from lightgbm_tpu.application import Application
+    with pytest.raises(lgb.LightGBMError):
+        Application(["task=online", "verbose=-1"]).run()
+    with pytest.raises(ValueError):
+        config_from_params({"refit_decay_rate": 1.5})
+    with pytest.raises(ValueError):
+        config_from_params({"online_mode": "nope"})
+    with pytest.raises(ValueError):
+        config_from_params({"online_trigger_rows": 0})
+    # aliases land on the canonical keys
+    cfg = config_from_params({"decay_rate": 0.25, "min_refit_rows": 3,
+                              "trigger_rows": 99, "refresh_mode": "continue"})
+    assert cfg.refit_decay_rate == 0.25 and cfg.refit_min_rows == 3
+    assert cfg.online_trigger_rows == 99 and cfg.online_mode == "continue"
+
+
+# ---------------------------------------------------------------------------
+# the closed loop: train -> serve -> drift -> refit -> hot-swap
+# ---------------------------------------------------------------------------
+
+
+def test_end_to_end_drift_loop_zero_recompile(tmp_path):
+    from lightgbm_tpu.serving import ModelRegistry
+    X, y = _synth(2000, seed=31)
+    drifted = 1.0 - y                               # concept inversion
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 15,
+              "min_data_in_leaf": 5, "online_trigger_rows": 256,
+              "refit_decay_rate": 0.0, "refit_min_rows": 1}
+    bst = lgb.train(params, lgb.Dataset(X[:1200], y[:1200]),
+                    num_boost_round=5)
+    pub = str(tmp_path / "model.txt")
+    tmp = pub + ".tmp"
+    bst.save_model(tmp)
+    os.replace(tmp, pub)
+
+    # serve generation 1 and warm the traffic bucket
+    reg = ModelRegistry(pub, params={"verbose": -1}, max_batch_rows=256)
+    eval_slice = X[1200:1456]                       # one full 256-bucket
+    p_before = reg.current().predict(eval_slice)
+    loss_before = np.mean(
+        np.abs(p_before - drifted[1200:1456]))
+
+    # labeled drifted traffic flows back into the trainer
+    traffic = str(tmp_path / "traffic.jsonl")
+    tr = OnlineTrainer(bst, traffic, pub, config=config_from_params(params))
+    append_traffic(traffic, X[:1200], drifted[:1200])
+    assert tr.poll_once() is True
+
+    # registry hot-swaps the refreshed generation with warm buckets
+    assert reg.maybe_reload() is True
+    assert reg.generation == 2
+    rt = reg.current()
+    misses = rt.cache_misses
+    p_after = rt.predict(eval_slice)
+    assert rt.cache_misses == misses                # zero request-path compiles
+    loss_after = np.mean(np.abs(p_after - drifted[1200:1456]))
+    assert loss_after < loss_before - 0.15, (loss_before, loss_after)
+
+
+def test_server_stats_surfaces_online_metadata(tmp_path):
+    from lightgbm_tpu.serving import ModelRegistry
+    from lightgbm_tpu.serving.server import PredictionServer
+    X, y = _synth(600, seed=41)
+    bst = _train(X, y, {"objective": "binary", "num_leaves": 7}, 3)
+    pub = str(tmp_path / "m.txt")
+    bst.save_model(pub)
+    reg = ModelRegistry(pub, params={"verbose": -1}, max_batch_rows=64)
+    srv = PredictionServer(reg, host="127.0.0.1", port=0)
+    assert srv.stats()["online"] is None            # not an online publish
+    with open(pub + ".meta.json", "w") as f:
+        json.dump({"generation": 3, "mode": "refit", "rows": 123}, f)
+    st = srv.stats()
+    assert st["online"]["generation"] == 3
+    assert st["online"]["rows"] == 123
+
+
+# ---------------------------------------------------------------------------
+# steady-state contract: 0 retraces / 0 implicit transfers
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.sanitize
+def test_refit_loop_steady_state_sanitized():
+    from lightgbm_tpu.diagnostics.sanitize import (HotPathSanitizer,
+                                                   transfer_guard_effective)
+    if not transfer_guard_effective():
+        pytest.skip("jax.transfer_guard is a no-op on this backend")
+    X, y = _synth(2400, seed=51)
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 15,
+              "min_data_in_leaf": 5, "refit_min_rows": 1,
+              "refit_decay_rate": 0.3}
+    bst = lgb.train(params, lgb.Dataset(X[:1600], y[:1600]),
+                    num_boost_round=5)
+    cfg = config_from_params(params)
+    base = RawDataset(X[:1600], y[:1600].astype(np.float32), cfg)
+    s = RawDataset.streaming_from(base, cfg)
+    rng = np.random.RandomState(0)
+
+    def fill(seed):
+        idx = rng.choice(2400, 700, replace=False)
+        s.append_rows(X[idx], y[idx])
+
+    fill(0)
+    ref = LeafRefitter(bst._gbdt, s)
+    san = HotPathSanitizer(warmup=1, label="online-refit")
+    with san:
+        for i in range(4):
+            with san.step():
+                ref.refit()
+            s.reset_rows()
+            fill(i + 1)
+    assert san.steps == 4
+    assert san.retraces == 0, san.compile_names
+    assert san.implicit_transfers == 0
