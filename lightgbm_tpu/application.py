@@ -92,16 +92,39 @@ class Application:
         _log(cfg, f"number of data: {train_raw.num_data}, number of "
                   f"features: {train_raw.num_features}")
 
-        gbdt = create_boosting(cfg, cfg.input_model)
+        # checkpoint resume: a prior run's snapshot replaces input_model
+        # (its trees INCLUDE whatever input_model seeded that run with)
+        from .boosting.gbdt import load_checkpoint
+        resume = (load_checkpoint(cfg.checkpoint_path)
+                  if cfg.checkpoint_path else None)
+        gbdt = create_boosting(cfg, "" if resume else cfg.input_model)
         from .objectives import create_objective
         objective = create_objective(cfg)
-        gbdt.reset_training_data(train_raw, objective)
+        start_it = 0
+        if resume is not None:
+            start_it = gbdt.resume_from_checkpoint(resume, train_raw,
+                                                   objective)
+            _log(cfg, f"resumed from checkpoint {cfg.checkpoint_path}: "
+                      f"iteration {start_it}, {gbdt.num_trees} trees")
+        else:
+            gbdt.reset_training_data(train_raw, objective)
         for i, vpath in enumerate(cfg.valid_data):
             vraw = RawDataset.from_file(vpath, cfg, reference=train_raw)
             gbdt.add_valid(vraw, f"valid_{i + 1}")
 
+        checkpointing = bool(cfg.checkpoint_path
+                             and cfg.checkpoint_interval > 0)
+        # an early-stopped run already rolled back past its best
+        # iteration; resuming its loop would just retrain the dropped
+        # tail until early stopping fires again — and the marker must
+        # survive a no-op rerun, or the rerun-after-that retrains it
+        resumed_early_stop = (resume is not None
+                              and resume.get("finished") == "early_stop")
+        if resumed_early_stop:
+            start_it = cfg.num_iterations
+        stopped_early = resumed_early_stop
         start = time.time()
-        for it in range(cfg.num_iterations):
+        for it in range(start_it, cfg.num_iterations):
             stop = gbdt.train_one_iter(None, None, is_eval=False)
             printing = (cfg.verbose >= 1 and cfg.metric_freq > 0
                         and (it + 1) % cfg.metric_freq == 0)
@@ -119,9 +142,18 @@ class Application:
                               f"{val:g}")
             _log(cfg, f"{time.time() - start:.6f} seconds elapsed, finished "
                       f"iteration {it + 1}")
+            if checkpointing and (it + 1) % cfg.checkpoint_interval == 0:
+                gbdt.save_checkpoint(cfg.checkpoint_path)
             if stop:
                 _log(cfg, "early stopping")
+                stopped_early = True
                 break
+        if checkpointing:
+            # final snapshot so a rerun after completion is a no-op
+            # resume instead of re-training the tail after the last
+            # periodic snapshot (early_stop marks the rolled-back run)
+            gbdt.save_checkpoint(cfg.checkpoint_path, extra={
+                "finished": "early_stop" if stopped_early else "complete"})
         gbdt.save_model_to_file(cfg.output_model)
         _log(cfg, f"finished training, model saved to {cfg.output_model}")
 

@@ -28,6 +28,19 @@ class DART(GBDT):
     def sub_model_name(self) -> str:
         return "dart"
 
+    def _extra_training_state(self):
+        from .gbdt import _rng_state_to_json
+        return {"drop_rng": _rng_state_to_json(self.drop_rng),
+                "tree_weight": [float(w) for w in self.tree_weight],
+                "sum_weight": float(self.sum_weight)}
+
+    def _restore_extra_training_state(self, state):
+        from .gbdt import _rng_state_from_json
+        if "drop_rng" in state:
+            self.drop_rng.set_state(_rng_state_from_json(state["drop_rng"]))
+        self.tree_weight = [float(w) for w in state.get("tree_weight", [])]
+        self.sum_weight = float(state.get("sum_weight", 0.0))
+
     def reset_training_data(self, train_set, objective=None):
         super().reset_training_data(train_set, objective)
         self.shrinkage_rate = self.config.learning_rate

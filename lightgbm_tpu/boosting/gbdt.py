@@ -17,7 +17,10 @@ per-iteration flow is (1) one fused elementwise gradient program,
 """
 from __future__ import annotations
 
+import dataclasses
+import hashlib
 import io
+import json
 import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -32,6 +35,81 @@ from ..metrics import Metric, create_metric
 from ..objectives import Objective, create_objective, objective_from_model_string
 from ..tree import Tree, NUMERICAL_DECISION
 from .score_updater import ScoreUpdater
+
+
+CHECKPOINT_VERSION = 1
+
+# fields that may legitimately differ between the run that wrote a
+# checkpoint and the run resuming it (paths, logging, and the resume
+# machinery itself); everything else participates in the fingerprint —
+# resuming under a different training recipe is an error, not a merge
+_FINGERPRINT_EXCLUDE = frozenset({
+    "task", "verbose", "num_threads", "num_iterations", "input_model",
+    "output_model", "output_result", "config_file", "output_freq",
+    "checkpoint_path", "checkpoint_interval",
+    # serving / online-daemon knobs: they configure how a model is
+    # SERVED or refreshed, never how it trains — editing serve_port in
+    # the config file between crash and resume must not discard the run
+    "serve_host", "serve_port", "max_batch_rows", "flush_deadline_ms",
+    "model_poll_seconds", "min_bucket_rows", "serve_replicas",
+    "max_pending_rows", "serve_request_timeout_ms",
+    "replica_failure_threshold",
+    "refit_decay_rate", "refit_min_rows", "online_trigger_rows",
+    "online_mode",
+})
+
+
+def config_fingerprint(config: Config) -> str:
+    """Stable digest of every training-relevant Config field."""
+    d = dataclasses.asdict(config)
+    items = sorted((k, repr(v)) for k, v in d.items()
+                   if k not in _FINGERPRINT_EXCLUDE)
+    return hashlib.sha1(repr(items).encode()).hexdigest()
+
+
+def _rng_state_to_json(rng: np.random.RandomState) -> Dict:
+    kind, keys, pos, has_gauss, cached = rng.get_state()
+    return {"kind": kind, "keys": np.asarray(keys).tolist(), "pos": int(pos),
+            "has_gauss": int(has_gauss), "cached": float(cached)}
+
+
+def _rng_state_from_json(d: Dict) -> Tuple:
+    return (str(d["kind"]), np.asarray(d["keys"], np.uint32), int(d["pos"]),
+            int(d["has_gauss"]), float(d["cached"]))
+
+
+def load_checkpoint(path: str) -> Optional[Dict]:
+    """Parse a training checkpoint; None when absent or unreadable.
+
+    A torn/corrupt checkpoint (a crash artifact) must not wedge the
+    restarted run: it logs a warning and training starts from scratch
+    (or from ``input_model``), exactly as if no checkpoint existed.
+    """
+    from .. import log
+    try:
+        with open(path) as f:
+            state = json.load(f)
+    except FileNotFoundError:
+        return None
+    except OSError as e:
+        # an existing-but-unreadable checkpoint (EACCES/EIO) must not
+        # look like "no checkpoint": losing the resume silently discards
+        # every checkpointed iteration
+        log.warning(f"could not read checkpoint {path} "
+                    f"({type(e).__name__}: {e}); starting fresh")
+        return None
+    except ValueError as e:
+        log.warning(f"ignoring unreadable checkpoint {path} "
+                    f"({type(e).__name__}: {e}); starting fresh")
+        return None
+    if (not isinstance(state, dict)
+            or state.get("version") != CHECKPOINT_VERSION
+            or "model" not in state):
+        log.warning(f"ignoring incompatible checkpoint {path} "
+                    f"(version {state.get('version') if isinstance(state, dict) else '?'}); "
+                    "starting fresh")
+        return None
+    return state
 
 
 class GBDT:
@@ -58,6 +136,12 @@ class GBDT:
         self.max_feature_idx = 0
         self._early_stopping_state: Dict = {}
         self._predict_stack_cache: Dict = {}
+        # checkpoint resume forces the SEQUENTIAL per-tree score replay
+        # ("walk"): it adds trees in exactly training's accumulation
+        # order, so resumed scores are bitwise the uninterrupted run's.
+        # The tensorized ensemble replay reassociates the f32 sum —
+        # exact on dyadic leaf values, last-ULP different otherwise.
+        self._replay_kernel: Optional[str] = None
         if train_set is not None:
             self.reset_training_data(train_set, objective)
 
@@ -84,7 +168,8 @@ class GBDT:
             t.rebin_to_dataset(train_set)
         if self.models:
             self.train_score.add_trees(self.models, self.K,
-                                       cfg.predict_kernel)
+                                       self._replay_kernel
+                                       or cfg.predict_kernel)
         self.feature_names = list(train_set.feature_names)
         self.feature_infos = train_set.feature_infos()
         self.max_feature_idx = train_set.num_total_features - 1
@@ -140,7 +225,8 @@ class GBDT:
         for t in self.models:
             t.rebin_to_dataset(valid_set)
         if self.models:
-            su.add_trees(self.models, self.K, cfg.predict_kernel)
+            su.add_trees(self.models, self.K,
+                         self._replay_kernel or cfg.predict_kernel)
         self.valid_sets.append((name, valid_set, su, ms))
 
     # ------------------------------------------------------------------
@@ -743,6 +829,99 @@ class GBDT:
             "tree_info": [dict(tree_index=i, **t.to_json())
                           for i, t in enumerate(self.models)],
         }
+
+    # -- checkpoint / resume (docs/Robustness.md) ----------------------
+
+    def _extra_training_state(self) -> Dict:
+        """Subclass hook: sampler/boosting state beyond the base GBDT's
+        (GOSS key, DART drop RNG + tree weights)."""
+        return {}
+
+    def _restore_extra_training_state(self, state: Dict) -> None:
+        pass
+
+    def training_state(self) -> Dict:
+        """Everything a resumed run needs to continue BITWISE where this
+        one stands: the model text, the iteration/continuation counters,
+        the early-stopping bests, and the exact sampler RNG state (a
+        re-seeded RNG would re-draw the first bags and fork the run)."""
+        self._flush_pending()
+        state = {
+            "version": CHECKPOINT_VERSION,
+            "fingerprint": config_fingerprint(self.config),
+            "boosting": self.sub_model_name(),
+            "iteration": self.iter_,
+            "num_init_iteration": self.num_init_iteration,
+            "shrinkage_rate": self.shrinkage_rate,
+            "early_stopping": [
+                [name, metric, cmp, it]
+                for (name, metric), (cmp, it)
+                in self._early_stopping_state.items()],
+            "bag_rng": _rng_state_to_json(self.bag_rng),
+            "model": self.save_model_to_string(),
+        }
+        state.update(self._extra_training_state())
+        return state
+
+    def save_checkpoint(self, path: str,
+                        extra: Optional[Dict] = None) -> None:
+        """Atomic snapshot: tmp + os.replace, so a crash mid-write
+        leaves the PREVIOUS checkpoint intact, never a torn one.
+        ``extra`` rides along in the state dict (the CLI records a
+        ``finished`` marker so reruns of a completed command no-op)."""
+        from .. import log
+        from ..diagnostics import faults
+        state = self.training_state()
+        if extra:
+            state.update(extra)
+        payload = json.dumps(state)
+        faults.torn_write("train.checkpoint", path, payload)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(payload)
+        os.replace(tmp, path)
+        log.debug(f"checkpoint saved to {path} (iteration {self.iter_}, "
+                  f"{len(self.models)} trees)")
+        faults.check("train.after_checkpoint")
+
+    def restore_training_state(self, state: Dict) -> None:
+        """Apply a checkpoint's counters + RNG state.  Call AFTER
+        ``load_model_from_string(state['model'])`` + ``reset_training_data``
+        (which replays the restored trees onto the training/valid
+        scores) — this restores what the replay cannot."""
+        from ..log import LightGBMError
+        fp = config_fingerprint(self.config)
+        if state.get("fingerprint") != fp:
+            raise LightGBMError(
+                "checkpoint was written under a different training "
+                "config (fingerprint mismatch); resuming would silently "
+                "mix recipes — delete the checkpoint to start fresh, or "
+                "restore the original parameters")
+        if state.get("boosting") != self.sub_model_name():
+            raise LightGBMError(
+                f"checkpoint holds a {state.get('boosting')!r} model, "
+                f"this run is {self.sub_model_name()!r}")
+        self.iter_ = int(state["iteration"])
+        self.num_init_iteration = int(state.get("num_init_iteration", 0))
+        self.shrinkage_rate = float(state["shrinkage_rate"])
+        self._early_stopping_state = {
+            (name, metric): (float(cmp), int(it))
+            for name, metric, cmp, it in state.get("early_stopping", [])}
+        if state.get("bag_rng"):
+            self.bag_rng.set_state(_rng_state_from_json(state["bag_rng"]))
+        self._restore_extra_training_state(state)
+
+    def resume_from_checkpoint(self, state: Dict, train_set: Dataset,
+                               objective: Optional[Objective] = None) -> int:
+        """One-call resume: load the checkpoint model, replay it onto
+        fresh training scores, restore counters/RNG.  Returns the
+        iteration to continue from.  Valid sets added AFTER this call
+        replay the restored model automatically (add_valid does)."""
+        self.load_model_from_string(state["model"])
+        self._replay_kernel = "walk"     # order-exact replay (see __init__)
+        self.reset_training_data(train_set, objective)
+        self.restore_training_state(state)
+        return self.iter_
 
 
 def create_boosting(config: Config, model_file: str = "") -> "GBDT":

@@ -58,6 +58,22 @@ class GOSS(GBDT):
     def sub_model_name(self) -> str:
         return "goss"
 
+    def _extra_training_state(self):
+        # the raw uint32 key words; jax.random.key_data unwraps typed
+        # keys, raw legacy keys pass through np.asarray unchanged
+        key = self._goss_key
+        try:
+            key = jax.random.key_data(key)
+        except TypeError:
+            pass
+        return {"goss_key":
+                jax.device_get(key).astype(np.uint32).tolist()}
+
+    def _restore_extra_training_state(self, state):
+        if "goss_key" in state:
+            self._goss_key = jnp.asarray(
+                np.asarray(state["goss_key"], np.uint32))
+
     def train_one_iter(self, gradient=None, hessian=None,
                        is_eval: bool = False) -> bool:
         self._boost_from_average()

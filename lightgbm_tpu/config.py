@@ -112,6 +112,10 @@ PARAM_ALIASES: Dict[str, str] = {
     "poll_seconds": "model_poll_seconds",
     "serving_replicas": "serve_replicas",
     "num_replicas": "serve_replicas",
+    "request_timeout_ms": "serve_request_timeout_ms",
+    "serve_timeout_ms": "serve_request_timeout_ms",
+    "failure_threshold": "replica_failure_threshold",
+    "serve_failure_threshold": "replica_failure_threshold",
     "serve_max_pending_rows": "max_pending_rows",
     "pending_rows_cap": "max_pending_rows",
     "prediction_kernel": "predict_kernel",
@@ -124,6 +128,11 @@ PARAM_ALIASES: Dict[str, str] = {
     "online_trigger": "online_trigger_rows",
     "trigger_rows": "online_trigger_rows",
     "refresh_mode": "online_mode",
+    # fault tolerance (task=train checkpoint/resume, docs/Robustness.md)
+    "checkpoint": "checkpoint_path",
+    "snapshot_path": "checkpoint_path",
+    "checkpoint_freq": "checkpoint_interval",
+    "snapshot_freq": "checkpoint_interval",
     # exclusive feature bundling (EFB)
     "efb": "enable_bundle",
     "bundle": "enable_bundle",
@@ -355,6 +364,22 @@ class Config:
     # queue (high-water mark — a single over-cap request on an idle
     # server still admits).  0 = unbounded.
     max_pending_rows: int = 0
+    # a /predict request whose batch has not scored within this window
+    # answers HTTP 504 (the batch keeps scoring; only the waiter gives
+    # up) — the client-visible bound on a wedged or overloaded fleet.
+    serve_request_timeout_ms: float = 120000.0
+    # replica circuit breaker: after this many CONSECUTIVE dispatch
+    # failures a replica stops receiving traffic; a periodic half-open
+    # probe readmits it once it answers again (docs/Robustness.md).
+    replica_failure_threshold: int = 3
+
+    # -- fault tolerance (task=train checkpoint/resume, docs/Robustness.md)
+    # when set, training snapshots (model + iteration + early-stopping +
+    # sampler RNG state) to this path every `checkpoint_interval`
+    # iterations (atomic tmp + rename), and a rerun pointing at an
+    # existing checkpoint resumes mid-run instead of starting over.
+    checkpoint_path: str = ""
+    checkpoint_interval: int = 0      # iterations between snapshots (0 = off)
 
     # -- online learning (task=online / task=refit, lightgbm_tpu/online/)
     # leaf-value refit blends the Newton leaf output computed on fresh
@@ -496,6 +521,12 @@ def check_param_conflict(cfg: Config) -> None:
         raise ValueError("serve_replicas must be >= 0 (0 = auto)")
     if cfg.max_pending_rows < 0:
         raise ValueError("max_pending_rows must be >= 0 (0 = unbounded)")
+    if cfg.serve_request_timeout_ms <= 0:
+        raise ValueError("serve_request_timeout_ms must be > 0")
+    if cfg.replica_failure_threshold < 1:
+        raise ValueError("replica_failure_threshold must be >= 1")
+    if cfg.checkpoint_interval < 0:
+        raise ValueError("checkpoint_interval must be >= 0 (0 = off)")
     if cfg.predict_kernel not in PREDICT_KERNELS:
         raise ValueError(f"unknown predict_kernel: {cfg.predict_kernel}")
     if not (0.0 <= cfg.refit_decay_rate <= 1.0):

@@ -1,6 +1,8 @@
-"""Hot-path diagnostics: graftlint static analysis (`lint`) and the
-runtime retrace/transfer sanitizer (`sanitize`).
+"""Hot-path diagnostics: graftlint static analysis (`lint`), the
+runtime retrace/transfer sanitizer (`sanitize`), and the deterministic
+fault-injection registry (`faults`).
 
-`lint` is stdlib-only (no jax import) so the CI gate stays cheap;
-`sanitize` imports jax lazily inside the context manager.
+`lint` and `faults` are stdlib-only (no jax import) so the CI gate and
+the fault seams stay cheap; `sanitize` imports jax lazily inside the
+context manager.
 """

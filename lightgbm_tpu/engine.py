@@ -62,6 +62,37 @@ def train(params: Dict[str, Any], train_set: Dataset,
         booster._gbdt.boost_from_average_used = (
             init_booster._gbdt.boost_from_average_used)
 
+    # checkpoint/resume (docs/Robustness.md): `checkpoint_path` /
+    # `checkpoint_interval` params give the Python API the same
+    # kill-and-resume story as CLI task=train.  Resume happens BEFORE
+    # add_valid so the restored model replays onto valid scores too.
+    ckpt = booster._gbdt.config
+    start_round = 0
+    resumed_early_stop = False
+    if ckpt.checkpoint_path:
+        from .boosting.gbdt import load_checkpoint
+        state = load_checkpoint(ckpt.checkpoint_path)
+        if state is not None:
+            g = booster._gbdt
+            start_round = g.resume_from_checkpoint(state, g.train_set,
+                                                   g.objective)
+            resumed_early_stop = state.get("finished") == "early_stop"
+            if resumed_early_stop:
+                # the early-stopped run rolled its best_iteration back;
+                # without this the skipped loop would fall through to
+                # current_iteration() (the FULL tree count)
+                booster.best_iteration = int(state.get("best_iteration", 0))
+            elif 0 < start_round < num_boost_round and (
+                    early_stopping_rounds or any(
+                        getattr(cb, "order", None) == 30
+                        for cb in (callbacks or []))):
+                from . import log
+                log.warning(
+                    "checkpoint resume cannot restore the early-stopping "
+                    "callback's best-score history; it restarts at the "
+                    "resume point, so the stopping round may differ from "
+                    "an uninterrupted run")
+
     if valid_sets is not None:
         if isinstance(valid_sets, Dataset):
             valid_sets = [valid_sets]
@@ -99,13 +130,22 @@ def train(params: Dict[str, Any], train_set: Dataset,
     has_valid = bool(booster._valid_names)
     train_in_valid = (valid_sets is not None
                       and any(vs is train_set for vs in valid_sets))
-    for i in range(num_boost_round):
+    # a checkpointed run that already early-stopped keeps its result; the
+    # early-stopping callback's state is not checkpointable, so re-entering
+    # the loop would retrain the tail until early stopping fires again
+    if resumed_early_stop:
+        start_round = num_boost_round
+    stopped_early = resumed_early_stop
+    for i in range(start_round, num_boost_round):
         env = callback_mod.CallbackEnv(
             model=booster, params=params, iteration=i, begin_iteration=0,
             end_iteration=num_boost_round, evaluation_result_list=None)
         for cb in cbs_before:
             cb(env)
         finished = booster.update(fobj=fobj)
+        if (ckpt.checkpoint_path and ckpt.checkpoint_interval > 0
+                and (i + 1) % ckpt.checkpoint_interval == 0):
+            booster._gbdt.save_checkpoint(ckpt.checkpoint_path)
         evaluation_result_list = []
         if train_in_valid or params.get("is_training_metric"):
             evaluation_result_list.extend(booster.eval_train(feval))
@@ -117,9 +157,17 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 cb(env)
         except callback_mod.EarlyStopException as e:
             booster.best_iteration = e.best_iteration + 1
+            stopped_early = True
             break
         if finished:
             break
+    if ckpt.checkpoint_path and ckpt.checkpoint_interval > 0:
+        # final snapshot (mirrors the CLI): a rerun of this completed
+        # call resumes past the loop instead of retraining the tail
+        # since the last periodic snapshot
+        booster._gbdt.save_checkpoint(ckpt.checkpoint_path, extra={
+            "finished": "early_stop" if stopped_early else "complete",
+            "best_iteration": int(booster.best_iteration)})
     if booster.best_iteration <= 0:
         booster.best_iteration = booster.current_iteration()
     return booster

@@ -30,6 +30,7 @@ def append_traffic(path: str, X: np.ndarray, y: np.ndarray,
                    weight: Optional[np.ndarray] = None) -> int:
     """Append labeled rows to a traffic log (the writer half — what a
     serving-side label joiner produces); returns rows written."""
+    from ..diagnostics import faults
     X = np.asarray(X, np.float64)
     if X.ndim == 1:
         X = X.reshape(1, -1)
@@ -42,7 +43,15 @@ def append_traffic(path: str, X: np.ndarray, y: np.ndarray,
                    "label": float(y[i])}
             if weight is not None:
                 rec["weight"] = float(np.asarray(weight).reshape(-1)[i])
-            f.write(json.dumps(rec) + "\n")
+            line = json.dumps(rec) + "\n"
+            # chaos seam: a writer dying mid-append leaves a torn tail —
+            # exactly what the reader's complete-lines-only contract
+            # must absorb (tests/test_faults.py)
+            if faults.fire("traffic.append"):
+                f.write(line[: max(1, len(line) // 2)])
+                f.flush()
+                raise faults.InjectedFault("traffic.append", 0)
+            f.write(line)
     return len(X)
 
 
@@ -62,11 +71,30 @@ class TrafficLog:
         self.offset = 0           # byte offset of the first unread line
         self.rows_read = 0
         self.bad_lines = 0
+        self.overcap_skips = 0    # single lines larger than max_poll_bytes
         self._width = (int(expected_features)
                        if expected_features else None)
         # per-poll read cap: a daemon (re)started against a multi-GB
         # backlog must drain it in bounded slices, not one giant blob
         self._max_poll = int(max_poll_bytes)
+
+    def counters(self) -> dict:
+        """Silent-data-loss evidence for /stats (docs/Robustness.md):
+        rows consumed, malformed lines skipped, over-cap lines skipped,
+        and the current byte offset."""
+        return {"offset": int(self.offset), "rows_read": int(self.rows_read),
+                "bad_lines": int(self.bad_lines),
+                "overcap_skips": int(self.overcap_skips)}
+
+    def seek(self, offset: int, counters: Optional[dict] = None) -> None:
+        """Restore a persisted read position (daemon restart): the next
+        read_new() continues from `offset` instead of byte 0."""
+        self.offset = max(0, int(offset))
+        if counters:
+            self.rows_read = int(counters.get("rows_read", self.rows_read))
+            self.bad_lines = int(counters.get("bad_lines", self.bad_lines))
+            self.overcap_skips = int(counters.get("overcap_skips",
+                                                  self.overcap_skips))
 
     def read_new(self) -> Optional[Tuple[np.ndarray, np.ndarray,
                                          Optional[np.ndarray]]]:
@@ -95,6 +123,7 @@ class TrafficLog:
                 # (its remainder parses as one more bad line later)
                 self.offset += len(blob)
                 self.bad_lines += 1
+                self.overcap_skips += 1
             return None             # else: only a torn tail so far
         consumed = blob[: last_nl + 1]
         self.offset += len(consumed)

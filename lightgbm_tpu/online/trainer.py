@@ -24,12 +24,33 @@ Bin mappers freeze at the FIRST trigger window (or from an explicit
 `reference` dataset): every later chunk re-uses them, so no chunk is
 ever re-quantized and the stores stay aligned with the trees' rebinned
 thresholds.
+
+Crash safety (docs/Robustness.md): the daemon persists a state sidecar
+(``<output_model>.state.json``, tmp + os.replace like `_publish`)
+holding the traffic byte offset covered by the latest publish, the
+generation/refresh counters, the frozen-mapper fingerprint, the traffic
+reader's data-loss counters, and the last refresh outcome.  A restarted
+daemon resumes from that offset — rows already inside a published
+generation are never re-processed, rows of the in-flight window are
+re-read from the log and land in exactly one future publish.  Publishes
+are guarded by a WRITE-AHEAD INTENT in the sidecar, flushed after the
+model is staged but before anything touches the publish path: on
+restart, the intent's generation vs the published ``.meta.json`` — and,
+for a crash BETWEEN the model and meta renames, the staged model's
+recorded sha1 vs what sits at the publish path — decide adopt
+(completing the publish from the intent's recorded meta) vs redo.  The frozen bin mappers persist
+as a binary dataset sidecar (``<output_model>.refbin``) so a restart
+bins against BITWISE the same mappers instead of re-freezing from
+whatever window happens to be pending.  SIGTERM drains the current
+poll and flushes state before exit.
 """
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import os
+import signal
 import threading
 import time
 from typing import List, Optional, Tuple
@@ -39,9 +60,20 @@ import numpy as np
 from .. import log
 from ..config import Config, config_from_params
 from ..dataset import Dataset as RawDataset
+from ..diagnostics import faults
 from ..log import LightGBMError
 from .refit import LeafRefitter
 from .stream import TrafficLog
+
+STATE_VERSION = 1
+
+
+def _file_sha1(path: str) -> str:
+    h = hashlib.sha1()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
 
 
 def _booster_params(cfg: Config) -> dict:
@@ -58,7 +90,8 @@ class OnlineTrainer:
     """Traffic-watching refresh daemon (see module docstring)."""
 
     def __init__(self, booster, traffic_path: str, publish_path: str, *,
-                 config: Optional[Config] = None, reference=None):
+                 config: Optional[Config] = None, reference=None,
+                 resume: bool = True):
         cfg = config or config_from_params(booster.params)
         if not booster._gbdt.models:
             raise LightGBMError("task=online needs a trained input model")
@@ -70,11 +103,19 @@ class OnlineTrainer:
         self.traffic = TrafficLog(traffic_path,
                                   expected_features=booster.num_feature())
         self.publish_path = publish_path
+        self.state_path = publish_path + ".state.json"
+        self.refbin_path = publish_path + ".refbin"
         self.mode = cfg.online_mode
         self.trigger = int(cfg.online_trigger_rows)
         self.generation = 0
         self.refreshes = 0
         self.rows_seen = 0
+        # crash-safety bookkeeping: the byte offset covered by the
+        # latest publish (where a restarted daemon resumes reading),
+        # the frozen-mapper fingerprint, and the last refresh outcome
+        self._published_offset = 0
+        self._mapper_fp: Optional[str] = None
+        self._last_refresh: Optional[dict] = None
         # window state: raw chunks buffer until the first trigger
         # freezes the bin mappers, then a streaming Dataset takes over
         self._window: Optional[RawDataset] = None
@@ -91,6 +132,8 @@ class OnlineTrainer:
         if reference is not None:
             self._window = RawDataset.streaming_from(
                 reference, cfg, capacity=self.trigger)
+        if resume:
+            self._try_resume()
 
     @classmethod
     def from_config(cls, cfg: Config) -> "OnlineTrainer":
@@ -106,6 +149,173 @@ class OnlineTrainer:
         booster = Booster(params=_booster_params(cfg),
                           model_file=cfg.input_model)
         return cls(booster, cfg.data, cfg.output_model, config=cfg)
+
+    # -- crash-safe state (docs/Robustness.md) --------------------------
+
+    def _state_dict(self, intent: Optional[dict] = None) -> dict:
+        st = {
+            "version": STATE_VERSION,
+            "generation": self.generation,
+            "refreshes": self.refreshes,
+            "rows_seen": int(self.rows_seen),
+            "published_offset": int(self._published_offset),
+            "pending_rows": int(self.pending_rows()),
+            "mode": self.mode,
+            "trigger_rows": self.trigger,
+            "mapper_fingerprint": self._mapper_fp,
+            "traffic": self.traffic.counters(),
+            "last_refresh": self._last_refresh,
+            "updated_unix": round(time.time(), 3),
+        }
+        if intent is not None:
+            st["publish_intent"] = intent
+        return st
+
+    def _flush_state(self, intent: Optional[dict] = None) -> None:
+        """Persist the daemon state sidecar (tmp + os.replace — the
+        same atomicity discipline as `_publish`)."""
+        payload = json.dumps(self._state_dict(intent))
+        faults.torn_write("online.state_write", self.state_path, payload)
+        tmp = self.state_path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(payload)
+        os.replace(tmp, self.state_path)
+
+    def _try_resume(self) -> None:
+        """Adopt a previous daemon's persisted state: traffic offset,
+        generation counters, published model, frozen bin mappers.  A
+        torn/unreadable sidecar logs a warning and starts fresh — a
+        crash artifact must never wedge the restart."""
+        try:
+            with open(self.state_path) as f:
+                st = json.load(f)
+        except FileNotFoundError:
+            return                        # first run: no sidecar yet
+        except OSError as e:
+            # an existing-but-unreadable sidecar (EACCES/EIO) silently
+            # treated as a first run would reset the traffic offset to 0
+            # and double-process every published row
+            log.warning(f"online: could not read state sidecar "
+                        f"{self.state_path} ({type(e).__name__}: {e}); "
+                        "starting fresh (traffic re-reads from offset 0)")
+            return
+        except ValueError as e:
+            log.warning(f"online: ignoring unreadable state sidecar "
+                        f"{self.state_path} ({type(e).__name__}: {e}); "
+                        "starting fresh (traffic re-reads from offset 0)")
+            return
+        if not isinstance(st, dict) or st.get("version") != STATE_VERSION:
+            log.warning(f"online: ignoring incompatible state sidecar "
+                        f"{self.state_path}; starting fresh")
+            return
+        offset = int(st.get("published_offset", 0))
+        self.generation = int(st.get("generation", 0))
+        self.refreshes = int(st.get("refreshes", 0))
+        self.rows_seen = int(st.get("rows_seen", 0))
+        self._last_refresh = st.get("last_refresh")
+        # publish-intent recovery: a crash BETWEEN the model rename and
+        # the state flush left the sidecar one publish behind.  The
+        # published .meta.json tells which side of the rename the crash
+        # fell on: landed -> adopt the intent (those rows are in the
+        # model; re-processing them would double-refit), not landed ->
+        # redo the window from the pre-intent offset.
+        intent = st.get("publish_intent")
+        if intent:
+            meta = self._read_meta()
+            landed = (meta is not None and
+                      meta.get("generation") == intent.get("generation"))
+            if not landed:
+                # the meta rename is the SECOND rename — the model may
+                # already have landed (crash between the two).  The
+                # intent's staged-model sha1 decides: if that is what
+                # sits at publish_path, COMPLETE the publish by staging
+                # the meta recorded in the intent; re-refitting the
+                # window would double-apply its rows to the new model.
+                sha = intent.get("model_sha1")
+                try:
+                    if (sha and os.path.exists(self.publish_path)
+                            and _file_sha1(self.publish_path) == sha):
+                        if intent.get("meta") is not None:
+                            mtmp = self.publish_path + ".meta.json.tmp"
+                            with open(mtmp, "w") as f:
+                                json.dump(intent["meta"], f)
+                            os.replace(mtmp,
+                                       self.publish_path + ".meta.json")
+                        landed = True
+                        log.info("online: completed interrupted publish "
+                                 f"generation {intent.get('generation')} "
+                                 "(crash fell between the model and "
+                                 "meta renames)")
+                except OSError as e:
+                    log.warning("online: could not verify an interrupted "
+                                f"publish ({type(e).__name__}: {e}); "
+                                "redoing the window")
+            if landed:
+                self.generation = int(intent["generation"])
+                self.refreshes = int(intent.get("refreshes",
+                                                self.refreshes + 1))
+                self.rows_seen = int(intent.get("rows_seen",
+                                                self.rows_seen))
+                offset = int(intent.get("offset", offset))
+                log.info(f"online: adopted in-flight publish generation "
+                         f"{self.generation} (crash fell after the model "
+                         "rename, before the state flush)")
+            else:
+                log.info("online: discarding unfinished publish intent "
+                         f"(generation {intent.get('generation')} never "
+                         "landed); its window re-reads from the log")
+        self._published_offset = offset
+        # counters ride along: the sidecar's bad_lines/overcap_skips are
+        # the operator's silent-data-loss evidence and must survive the
+        # restart, not reset to 0
+        self.traffic.seek(offset, st.get("traffic"))
+        # continue refreshing the PUBLISHED model (the one the fleet is
+        # serving), not the stale input model
+        if self.generation > 0 and os.path.exists(self.publish_path):
+            from ..basic import Booster
+            try:
+                self.booster = Booster(params=_booster_params(self.cfg),
+                                       model_file=self.publish_path)
+            except Exception as e:
+                log.warning(f"online: could not reload published model "
+                            f"{self.publish_path} ({type(e).__name__}: "
+                            f"{e}); continuing from the input model")
+        # frozen mappers: rebuild the streaming window from the refbin
+        # sidecar so restarted binning is bitwise the original run's
+        if self._window is None and os.path.exists(self.refbin_path):
+            fp = st.get("mapper_fingerprint")
+            try:
+                actual = _file_sha1(self.refbin_path)
+                if fp is not None and actual != fp:
+                    raise ValueError(
+                        f"fingerprint {actual[:12]} != recorded "
+                        f"{str(fp)[:12]} (torn write?)")
+                ref = RawDataset.from_binary(self.refbin_path, self.cfg)
+                self._window = RawDataset.streaming_from(
+                    ref, self.cfg, capacity=self.trigger)
+                self._mapper_fp = actual
+            except Exception as e:
+                log.warning(f"online: could not restore frozen mappers "
+                            f"from {self.refbin_path} ({type(e).__name__}"
+                            f": {e}); re-freezing from the next window")
+        log.info(f"online: resumed from {self.state_path} — generation "
+                 f"{self.generation}, traffic offset {offset}, "
+                 f"{self.rows_seen} rows seen")
+
+    def _read_meta(self) -> Optional[dict]:
+        try:
+            with open(self.publish_path + ".meta.json") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _save_refbin(self, base: RawDataset) -> None:
+        """Persist the frozen-mapper reference (atomic), so a restarted
+        daemon bins against the SAME mappers instead of re-freezing."""
+        tmp = self.refbin_path + ".tmp"
+        base.save_binary(tmp)
+        os.replace(tmp, self.refbin_path)
+        self._mapper_fp = _file_sha1(self.refbin_path)
 
     # -- ingestion ------------------------------------------------------
 
@@ -154,6 +364,15 @@ class OnlineTrainer:
                 self.booster._gbdt.predict_leaf_index(Xa))
         self._buffer = []
         self._buffered_rows = 0
+        # the frozen mappers outlive this process: a restarted daemon
+        # restores them from the sidecar instead of re-freezing from
+        # whatever window happens to be pending at restart time
+        try:
+            self._save_refbin(base)
+        except OSError as e:
+            log.warning(f"online: could not persist frozen mappers to "
+                        f"{self.refbin_path} ({type(e).__name__}: {e}); "
+                        "a restart would re-freeze from its first window")
         log.info(f"online: froze bin mappers from the first "
                  f"{len(Xa)}-row window "
                  f"({self._window.num_features} used features, "
@@ -192,11 +411,21 @@ class OnlineTrainer:
                 leaf = None
             stats = self._refitter.refit(leaf_idx=leaf)
         stats["refresh_seconds"] = round(time.perf_counter() - t0, 4)
-        self.refreshes += 1
         self._publish(stats)
         window.reset_rows()
         self._leaf_chunks = []
+        self._published_offset = int(self.traffic.offset)
+        self._record_refresh(ok=True, rows=stats.get("rows", 0))
+        self._flush_state()
         return True
+
+    def _record_refresh(self, ok: bool, rows: int = 0,
+                        error: Optional[str] = None) -> None:
+        self._last_refresh = {"ok": bool(ok), "rows": int(rows),
+                              "generation": self.generation,
+                              "unix": round(time.time(), 3)}
+        if error:
+            self._last_refresh["error"] = error
 
     def _continue_boosting(self, window: RawDataset) -> dict:
         """Append num_iterations fresh trees on the window: the existing
@@ -219,14 +448,40 @@ class OnlineTrainer:
         """Atomically publish the refreshed model + metadata sidecar.
         os.replace is atomic on one filesystem, so the registry's
         (mtime, size) poll can never observe a half-written model."""
-        self.generation += 1
-        tmp = f"{self.publish_path}.g{self.generation}.tmp"
+        # the in-memory counters advance only once the publish LANDS:
+        # until then the sidecar's top-level state must keep describing
+        # the previous generation (a discarded intent on restart adopts
+        # the top-level values verbatim)
+        gen = self.generation + 1
+        tmp = f"{self.publish_path}.g{gen}.tmp"
         self.booster.save_model(tmp)
-        meta = {"generation": self.generation, "mode": self.mode,
-                "refreshes": self.refreshes,
+        meta = {"generation": gen, "mode": self.mode,
+                "refreshes": self.refreshes + 1,
                 "rows_seen": int(self.rows_seen),
                 "trigger_rows": self.trigger,
+                # silent-data-loss visibility: the traffic reader's
+                # skip counters ride into /stats' `online` block
+                "traffic": self.traffic.counters(),
                 "published_unix": round(time.time(), 3), **stats}
+        # write-ahead intent BEFORE anything touches publish_path: a
+        # crash anywhere in the rename window is resolved on restart.
+        # The staged model's sha1 disambiguates a crash BETWEEN the two
+        # renames (model landed, meta did not — the .meta.json generation
+        # alone cannot tell that apart from "nothing landed"), and the
+        # full meta payload rides along so restart can COMPLETE such an
+        # interrupted publish instead of double-refitting the window.
+        self._flush_state(intent={
+            "generation": gen,
+            "refreshes": self.refreshes + 1,
+            "rows_seen": int(self.rows_seen),
+            "offset": int(self.traffic.offset),
+            "model_sha1": _file_sha1(tmp),
+            "meta": meta})
+        # chaos seams: crash before anything lands / model file torn
+        # mid-write at the FINAL path (the no-tmp-discipline failure the
+        # registry's poll must survive) — tests/test_faults.py
+        faults.check("online.before_publish")
+        faults.torn_copy("online.publish_model", tmp, self.publish_path)
         mtmp = f"{self.publish_path}.meta.json.tmp"
         with open(mtmp, "w") as f:
             json.dump(meta, f)
@@ -234,7 +489,13 @@ class OnlineTrainer:
         # inconsistency window a /stats poll can observe is two
         # back-to-back renames, not a model save + json dump
         os.replace(tmp, self.publish_path)
+        # chaos seam: crash with the model landed but the meta not —
+        # the case only the intent's model sha1 can disambiguate
+        faults.check("online.between_renames")
         os.replace(mtmp, self.publish_path + ".meta.json")
+        self.generation = gen
+        self.refreshes += 1
+        faults.check("online.after_publish")
         log.info(f"online: published generation {self.generation} "
                  f"({self.mode}, {stats.get('rows', 0)} rows) to "
                  f"{self.publish_path}")
@@ -242,10 +503,16 @@ class OnlineTrainer:
     def run_forever(self, poll_seconds: Optional[float] = None,
                     stop: Optional[threading.Event] = None) -> None:
         """Blocking poll loop; `stop` lets tests (and signal handlers)
-        end it cleanly."""
+        end it cleanly.  SIGTERM drains: the current poll finishes, one
+        final poll ingests whatever already reached the log, and the
+        state sidecar flushes so the NEXT daemon resumes exactly here."""
         period = (self.cfg.model_poll_seconds if poll_seconds is None
                   else float(poll_seconds)) or 1.0
         stop = stop or threading.Event()
+        try:                           # main thread only; tests use `stop`
+            signal.signal(signal.SIGTERM, lambda *_: stop.set())
+        except (ValueError, OSError):
+            pass
         log.info(f"online: watching {self.traffic.path} every "
                  f"{period:g}s (mode={self.mode}, trigger="
                  f"{self.trigger} rows, publishing to "
@@ -253,5 +520,28 @@ class OnlineTrainer:
         while not stop.wait(period):
             try:
                 self.poll_once()
-            except Exception as e:   # never kill the daemon on one window
+            except faults.InjectedFault:
+                raise               # an injected CRASH is a crash: no
+                                    # drain, no state flush (chaos runs
+                                    # must exercise the cold restart)
+            except Exception as e:  # never kill the daemon on one window
+                self._record_refresh(
+                    ok=False, error=f"{type(e).__name__}: {e}")
                 log.warning(f"online refresh failed: {e}")
+                try:
+                    self._flush_state()   # the failure is /stats-visible
+                except OSError:
+                    pass
+        try:                        # drain: SIGTERM/stop arrived
+            self.poll_once()
+        except faults.InjectedFault:
+            raise
+        except Exception as e:
+            self._record_refresh(ok=False,
+                                 error=f"{type(e).__name__}: {e}")
+        try:
+            self._flush_state()
+        except OSError as e:
+            log.warning(f"online: final state flush failed: {e}")
+        log.info("online: stopped (state flushed to "
+                 f"{self.state_path})")

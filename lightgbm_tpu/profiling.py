@@ -57,6 +57,22 @@ HIST_ROWS_TOUCHED = "tree/hist_rows_touched"
 HIST_EXCHANGE_BYTES = "tree/hist_exchange_bytes"
 SPLIT_RECORDS_BYTES = "tree/split_records_bytes"
 
+# Canonical robustness counters (docs/Robustness.md), fed through
+# count() by the serving fleet's failover machinery and the registry:
+#  - REGISTRY_SWAP_FAILURES: hot-swap candidates rejected (corrupt/torn
+#    model files, failed compiles) — the old generation kept serving.
+#  - serve.replica_failures / serve.replica_broken /
+#    serve.replica_readmitted / serve.replica_probes: per-event breaker
+#    transitions; serve.chunk_retries counts failed chunks re-run on a
+#    healthy replica.  All surfaced at the server's /stats endpoint so
+#    silent degradation is an operator-visible signal.
+REGISTRY_SWAP_FAILURES = "registry/swap_failures"
+SERVE_CHUNK_RETRIES = "serve.chunk_retries"
+SERVE_REPLICA_FAILURES = "serve.replica_failures"
+SERVE_REPLICA_BROKEN = "serve.replica_broken"
+SERVE_REPLICA_READMITTED = "serve.replica_readmitted"
+SERVE_REPLICA_PROBES = "serve.replica_probes"
+
 
 @contextmanager
 def phase(name: str, force: bool = False) -> Iterator[None]:

@@ -12,7 +12,8 @@ active (generation, PredictorRuntime) pair and swaps it atomically:
   swap is as warm as the last one before it;
 - a model that fails to load or compile is rolled back: the old runtime
   keeps serving, the bad file signature is remembered so the poll loop
-  does not retry-spin on it, and `serve.swap_failure` is counted.
+  does not retry-spin on it, and `registry/swap_failures` is counted
+  (exception class + message logged and kept as `last_swap_error`).
 
 Readers never lock: `current()` is one attribute read; in-flight batches
 that pinned the previous runtime finish on it untouched.
@@ -40,7 +41,8 @@ class ModelRegistry:
                  min_bucket_rows: int = 16,
                  warmup_buckets: Sequence[int] = (1,),
                  warmup_kinds: Sequence[str] = OUTPUT_KINDS,
-                 predict_kernel: Optional[str] = None, replicas: int = 0):
+                 predict_kernel: Optional[str] = None, replicas: int = 0,
+                 failure_threshold: int = 3):
         self.model_path = model_path
         self.params = dict(params or {})
         self.num_iteration = num_iteration
@@ -51,6 +53,8 @@ class ModelRegistry:
         self.warmup_kinds = tuple(warmup_kinds)
         self.predict_kernel = predict_kernel
         self.replicas = replicas
+        self.failure_threshold = failure_threshold
+        self.last_swap_error: Optional[str] = None
         self._lock = threading.Lock()       # serializes WRITERS only
         self._failed_sig: Optional[Tuple[int, int]] = None
         self._hup_pending = False
@@ -83,7 +87,8 @@ class ModelRegistry:
                                 min_bucket_rows=self.min_bucket_rows,
                                 generation=generation,
                                 predict_kernel=self.predict_kernel,
-                                replicas=self.replicas)
+                                replicas=self.replicas,
+                                failure_threshold=self.failure_threshold)
 
     def maybe_reload(self, force: bool = False) -> bool:
         """Swap in the model file if it changed; True iff a swap landed.
@@ -117,15 +122,23 @@ class ModelRegistry:
                              | set(self.warmup_kinds))
                     runtime.warmup(sorted(buckets), sorted(kinds))
             except Exception as e:
+                # a corrupt/torn candidate model must be LOUD and
+                # visible at /stats, not a silent skip: exception class
+                # + message into the log, the canonical
+                # registry/swap_failures counter, and last_swap_error
+                # for the stats endpoint (docs/Robustness.md)
                 self.swap_failures += 1
                 self._failed_sig = sig
-                profiling.count("serve.swap_failure")
+                self.last_swap_error = f"{type(e).__name__}: {e}"
+                profiling.count(profiling.REGISTRY_SWAP_FAILURES)
                 log.warning(f"model hot-swap failed, keeping generation "
-                            f"{old.generation}: {e}")
+                            f"{old.generation} "
+                            f"({self.last_swap_error})")
                 return False
             self._runtime = runtime          # the atomic swap
             self._sig = sig
             self._failed_sig = None
+            self.last_swap_error = None
             self.swaps += 1
             profiling.count("serve.swap")
             log.info(f"hot-swapped model to generation "

@@ -31,20 +31,47 @@ literature prescribes (arXiv:1806.11248 §5, arXiv:2011.02022):
 Cache hits/misses, compile seconds, executed rows, and per-replica
 dispatch counts are recorded through the always-on `profiling` counters
 (exposed at the server's /stats endpoint).
+
+Replica health (docs/Robustness.md): every dispatch failure counts
+against its replica; after ``failure_threshold`` CONSECUTIVE failures
+the replica's circuit breaker opens and it stops receiving traffic.  A
+failed chunk is retried ONCE on the least-loaded healthy replica, so
+one bad chip degrades capacity, not availability.  Broken replicas
+readmit through a half-open probe: after ``probe_after`` dispatches
+were routed around a broken replica, one live request probes it — a
+success closes the breaker, a failure re-opens it for another
+``probe_after`` window (deterministic, count-based — no wall clock).
+With ZERO healthy replicas, dispatch raises `NoHealthyReplicaError`,
+which the HTTP layer maps to 503 (retryable) instead of a raw 500.
 """
 from __future__ import annotations
 
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .. import profiling
+from .. import log, profiling
+from ..diagnostics import faults
 from ..log import LightGBMError
 
 OUTPUT_KINDS = ("value", "raw")
+
+
+class NoHealthyReplicaError(LightGBMError):
+    """Every replica's circuit breaker is open — shed load (HTTP 503)."""
+
+
+class _ReplicaFailure(Exception):
+    """Internal: a dispatch failed on a specific replica (carries the
+    replica index so the retry can exclude it)."""
+
+    def __init__(self, replica_index: int, error: BaseException):
+        super().__init__(f"replica {replica_index} failed: {error}")
+        self.replica_index = replica_index
+        self.error = error
 
 
 def row_bucket(n: int, min_bucket: int, max_bucket: int) -> int:
@@ -74,9 +101,9 @@ def resolve_serve_replicas(replicas: int = 0) -> list:
 
 class _Replica:
     """One device's copy of the model: device-resident stacks plus its
-    own executable cache and dispatch bookkeeping."""
+    own executable cache and dispatch/health bookkeeping."""
     __slots__ = ("index", "device", "stacks", "compiled", "inflight",
-                 "dispatches")
+                 "dispatches", "failures", "broken", "skips", "probes")
 
     def __init__(self, index: int, device, stacks):
         self.index = index
@@ -85,6 +112,10 @@ class _Replica:
         self.compiled: Dict[Tuple[int, str], object] = {}
         self.inflight = 0
         self.dispatches = 0
+        self.failures = 0       # CONSECUTIVE dispatch failures
+        self.broken = False     # circuit breaker open
+        self.skips = 0          # dispatches routed around while broken
+        self.probes = 0         # half-open probes attempted
 
 
 class PredictorRuntime:
@@ -95,10 +126,15 @@ class PredictorRuntime:
     in-flight requests keep scoring against a consistent model.
     """
 
+    # dispatches routed AROUND a broken replica before one live request
+    # probes it (half-open); count-based so chaos runs are deterministic
+    PROBE_AFTER = 8
+
     def __init__(self, booster, *, num_iteration: int = -1,
                  max_batch_rows: int = 4096, min_bucket_rows: int = 16,
                  generation: int = 0, predict_kernel: Optional[str] = None,
-                 replicas: int = 0):
+                 replicas: int = 0, failure_threshold: int = 3,
+                 probe_after: Optional[int] = None):
         import jax
         from ..ops.predict import resolve_predict_kernel
 
@@ -146,6 +182,12 @@ class PredictorRuntime:
         self._rr = 0                  # round-robin tie-break cursor
         self.cache_hits = 0
         self.cache_misses = 0
+        # replica circuit breaker (module docstring): consecutive
+        # failures to open, routed-around dispatches to half-open probe
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.probe_after = max(1, int(self.PROBE_AFTER if probe_after
+                                      is None else probe_after))
+        self.chunk_retries = 0
 
     @property
     def replica_count(self) -> int:
@@ -155,6 +197,23 @@ class PredictorRuntime:
         """Per-replica dispatch counts (the /stats fleet view)."""
         with self._lock:
             return [r.dispatches for r in self.replicas]
+
+    def replica_health(self) -> List[dict]:
+        """Per-replica breaker state (the /stats `replicas.health`
+        view: which chips carry traffic, which are circuit-broken and
+        how close their half-open probe is)."""
+        with self._lock:
+            return [{"index": r.index,
+                     "state": "broken" if r.broken else "healthy",
+                     "consecutive_failures": r.failures,
+                     "dispatches": r.dispatches,
+                     "skips_since_broken": r.skips,
+                     "probes": r.probes}
+                    for r in self.replicas]
+
+    def healthy_count(self) -> int:
+        with self._lock:
+            return sum(1 for r in self.replicas if not r.broken)
 
     # -- model stacking -------------------------------------------------
 
@@ -312,31 +371,97 @@ class PredictorRuntime:
 
     # -- prediction -----------------------------------------------------
 
-    def _pick_replica(self) -> _Replica:
-        """Least-loaded dispatch with a round-robin tie-break, so an
-        idle fleet still spreads sequential traffic."""
+    def _pick_replica(self, exclude: FrozenSet[int] = frozenset(),
+                      allow_probe: bool = True) -> _Replica:
+        """Least-loaded HEALTHY dispatch with a round-robin tie-break.
+
+        Broken replicas are routed around; each route-around bumps
+        their skip counter, and once it reaches ``probe_after`` the
+        next request becomes that replica's half-open probe (a probe
+        failure retries on a healthy replica like any other failure,
+        so the probing client is still served).  Raises
+        NoHealthyReplicaError when no replica is dispatchable.
+        """
         with self._lock:
             n = len(self.replicas)
-            best = None
+            best = probe = None
             for off in range(n):
                 r = self.replicas[(self._rr + off) % n]
+                if r.index in exclude:
+                    continue
+                if r.broken:
+                    r.skips += 1
+                    if (allow_probe and probe is None
+                            and r.skips >= self.probe_after
+                            and r.inflight == 0):    # single-flight probe
+                        probe = r
+                    continue
                 if best is None or r.inflight < best.inflight:
                     best = r
+            if probe is not None:
+                probe.skips = 0
+                probe.probes += 1
+                profiling.count(profiling.SERVE_REPLICA_PROBES)
+                best = probe
+            if best is None:
+                raise NoHealthyReplicaError(
+                    f"no healthy predictor replica ({n} total, "
+                    f"{len(exclude)} excluded); retry later")
             self._rr = (best.index + 1) % n
             best.inflight += 1
             best.dispatches += 1
             return best
 
+    def _note_success(self, replica: _Replica) -> None:
+        with self._lock:
+            replica.failures = 0
+            if replica.broken:
+                replica.broken = False
+                replica.skips = 0
+                profiling.count(profiling.SERVE_REPLICA_READMITTED)
+                log.info(f"serving replica {replica.index} readmitted "
+                         "(half-open probe succeeded)")
+
+    def _note_failure(self, replica: _Replica, error: BaseException) -> None:
+        with self._lock:
+            replica.failures += 1
+            profiling.count(profiling.SERVE_REPLICA_FAILURES)
+            opened = (not replica.broken
+                      and replica.failures >= self.failure_threshold)
+            reopened = replica.broken
+            if opened:
+                replica.broken = True
+                replica.skips = 0
+                profiling.count(profiling.SERVE_REPLICA_BROKEN)
+            if reopened:
+                replica.skips = 0     # probe failed: wait another window
+        if opened:
+            log.warning(
+                f"serving replica {replica.index} circuit-broken after "
+                f"{replica.failures} consecutive failures "
+                f"({type(error).__name__}: {error}); traffic fails over "
+                "to the surviving replicas")
+
     def _run_compiled(self, bucket: int, kind: str, Xpad: np.ndarray,
-                      replica: Optional[_Replica] = None):
+                      replica: Optional[_Replica] = None,
+                      exclude: FrozenSet[int] = frozenset()):
         import jax
+        pinned = replica is not None
         if replica is None:
-            replica = self._pick_replica()
+            # a retry (non-empty exclude) must land on a HEALTHY replica:
+            # routing it to a broken one's half-open probe could fail the
+            # request while healthy replicas sit idle
+            replica = self._pick_replica(exclude,
+                                         allow_probe=not exclude)
         else:                          # warmup pins the replica itself
             with self._lock:
                 replica.inflight += 1
                 replica.dispatches += 1
         try:
+            # chaos seams: a dispatch raising (any replica / THIS
+            # replica) is the circuit breaker's trigger condition
+            faults.check("serve.dispatch")
+            faults.check(f"serve.dispatch.r{replica.index}")
             exe = self._get_executable(replica, bucket, kind)
             # explicit device_put/device_get keeps the serving loop clean
             # under the sanitizer's transfer guard (BENCH_SANITIZE in
@@ -345,7 +470,15 @@ class PredictorRuntime:
             out = exe(replica.stacks,
                       jax.device_put(Xpad.astype(np.float32, copy=False),
                                      replica.device))
-            return jax.device_get(out).astype(np.float64)  # [K, bucket]
+            res = jax.device_get(out).astype(np.float64)  # [K, bucket]
+        except Exception as e:
+            self._note_failure(replica, e)
+            if pinned:                 # warmup: surface the raw error
+                raise
+            raise _ReplicaFailure(replica.index, e) from e
+        else:
+            self._note_success(replica)
+            return res
         finally:
             with self._lock:
                 replica.inflight -= 1
@@ -355,7 +488,28 @@ class PredictorRuntime:
         bucket = row_bucket(n, self.min_bucket_rows, self.max_batch_rows)
         if n < bucket:
             X = np.pad(X, ((0, bucket - n), (0, 0)))
-        return self._run_compiled(bucket, kind, X)[:, :n]
+        try:
+            out = self._run_compiled(bucket, kind, X)
+        except _ReplicaFailure as f:
+            # retry ONCE on a healthy replica other than the one that
+            # failed; its executable cache is as warm as the failed
+            # one's (warmup covers every replica), so the retry never
+            # compiles on the request path
+            self.chunk_retries += 1
+            profiling.count(profiling.SERVE_CHUNK_RETRIES)
+            try:
+                out = self._run_compiled(bucket, kind, X,
+                                         exclude=frozenset(
+                                             {f.replica_index}))
+            except NoHealthyReplicaError:
+                if self.healthy_count() == 0:
+                    raise              # total outage: 503, retryable
+                # only the exclusion emptied the pool (single-replica
+                # fleet, breaker not yet open): surface the real error
+                raise f.error from f
+            except _ReplicaFailure as f2:
+                raise f2.error from f2
+        return out[:, :n]
 
     def predict(self, X: np.ndarray, kind: str = "value") -> np.ndarray:
         """Score [n, F] rows; returns the same shapes as Booster.predict

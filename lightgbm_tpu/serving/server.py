@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import json
 import threading
+from concurrent.futures import TimeoutError as _FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -29,8 +30,7 @@ from ..config import Config
 from ..log import LightGBMError
 from .batcher import MicroBatcher, ServerOverloadedError
 from .registry import ModelRegistry
-
-_REQUEST_TIMEOUT_S = 120.0
+from .runtime import NoHealthyReplicaError
 
 
 def _parse_predict_body(body: bytes) -> np.ndarray:
@@ -115,7 +115,7 @@ class _Handler(BaseHTTPRequestHandler):
                    if "raw_score" in qs else srv.default_raw)
             kind = "raw" if raw else "value"
             fut = srv.batcher.submit(X, kind=kind)
-            preds = fut.result(timeout=_REQUEST_TIMEOUT_S)
+            preds = fut.result(timeout=srv.request_timeout_s)
             # the generation that actually scored this batch (pinned by
             # the flusher), not whatever is live at response time
             generation = getattr(fut, "generation",
@@ -123,7 +123,17 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, KeyError, json.JSONDecodeError) as e:
             self._respond_json(400, {"error": str(e)})
             return
-        except ServerOverloadedError as e:   # admission control: shed
+        except _FutureTimeout:               # serve_request_timeout_ms
+            profiling.count("serve.timeouts")
+            self._respond_json(504, {"error": (
+                "request timed out after "
+                f"{srv.request_timeout_s * 1e3:g} ms "
+                "(serve_request_timeout_ms); the batch may still be "
+                "scoring — retry with backoff")})
+            return
+        except (ServerOverloadedError, NoHealthyReplicaError) as e:
+            # shed load: admission control or a fully circuit-broken
+            # fleet — 503 tells the client to retry, unlike a raw 500
             self._respond_json(503, {"error": str(e)})
             return
         except LightGBMError as e:
@@ -152,10 +162,14 @@ class PredictionServer:
                  port: int = 0, max_batch_rows: int = 4096,
                  flush_deadline_ms: float = 5.0,
                  model_poll_seconds: float = 10.0,
-                 default_raw: bool = False, max_pending_rows: int = 0):
+                 default_raw: bool = False, max_pending_rows: int = 0,
+                 request_timeout_ms: float = 120000.0):
         self.registry = registry
         self.default_raw = default_raw
         self.model_poll_seconds = float(model_poll_seconds)
+        # /predict waiters give up (HTTP 504) after this long; the
+        # Config key is serve_request_timeout_ms
+        self.request_timeout_s = max(float(request_timeout_ms), 1.0) / 1e3
         # one flusher per predictor replica: while one batch scores on a
         # replica, the next forms and dispatches to an idle one —
         # continuous batching across the fleet
@@ -172,15 +186,37 @@ class PredictionServer:
         self._threads = []
 
     @staticmethod
-    def _model_meta(model_path: str):
-        """The online trainer's ``<model>.meta.json`` sidecar (generation
-        provenance: refresh mode, rows, publish time), or None when the
-        model is not published by an online loop."""
+    def _read_json_sidecar(path: str, what: str):
+        """Load an optional JSON sidecar.  Missing is normal (None); a
+        file that EXISTS but does not parse is an operator-relevant
+        failure — logged with the exception class/message and counted,
+        never silently swallowed."""
         try:
-            with open(model_path + ".meta.json") as f:
+            with open(path) as f:
                 return json.load(f)
-        except (OSError, ValueError):
+        except FileNotFoundError:
             return None
+        except (OSError, ValueError) as e:
+            profiling.count("registry/meta_failures")
+            log.warning(f"unreadable {what} sidecar {path} "
+                        f"({type(e).__name__}: {e})")
+            return None
+
+    @classmethod
+    def _model_meta(cls, model_path: str):
+        """The online trainer's ``<model>.meta.json`` sidecar (generation
+        provenance: refresh mode, rows, publish time) merged with its
+        ``.state.json`` daemon state (traffic offset/skip counters, last
+        refresh outcome) under ``daemon`` — or None when the model is
+        not published by an online loop."""
+        meta = cls._read_json_sidecar(model_path + ".meta.json",
+                                      "online meta")
+        state = cls._read_json_sidecar(model_path + ".state.json",
+                                       "online daemon state")
+        if state is not None:
+            meta = dict(meta or {})
+            meta["daemon"] = state
+        return meta
 
     def stats(self) -> dict:
         runtime = self.registry.current()
@@ -204,21 +240,33 @@ class PredictionServer:
                 "buckets": [list(k) for k in runtime.buckets_compiled()],
             },
             # the fleet view: replica count, per-replica dispatch
-            # counters (least-loaded balance evidence), kernel in use
+            # counters (least-loaded balance evidence), kernel in use,
+            # and per-replica circuit-breaker health + failover counters
             "replicas": {
                 "count": getattr(runtime, "replica_count", 1),
+                "healthy": (runtime.healthy_count()
+                            if hasattr(runtime, "healthy_count") else 1),
                 "dispatches": (runtime.replica_dispatches()
                                if hasattr(runtime, "replica_dispatches")
                                else []),
+                "health": (runtime.replica_health()
+                           if hasattr(runtime, "replica_health") else []),
+                "chunk_retries": getattr(runtime, "chunk_retries", 0),
+                "broken_total": profiling.counter_value(
+                    profiling.SERVE_REPLICA_BROKEN),
+                "readmitted_total": profiling.counter_value(
+                    profiling.SERVE_REPLICA_READMITTED),
                 "predict_kernel": getattr(runtime, "predict_kernel",
                                           "walk"),
             },
             "batch_workers": self.batcher.workers,
             "rejected": self.batcher.rejected,
+            "timeouts": profiling.counter_value("serve.timeouts"),
             "latency_ms": profiling.summary("serve.latency_ms"),
             "queue_depth_seen": profiling.summary("serve.queue_depth"),
             "swaps": self.registry.swaps,
             "swap_failures": self.registry.swap_failures,
+            "last_swap_error": self.registry.last_swap_error,
             "phase_totals_s": {k: round(v, 6)
                                for k, v in profiling.timings().items()
                                if k.startswith("serve/")},
@@ -272,13 +320,15 @@ def server_from_config(cfg: Config) -> PredictionServer:
         max_batch_rows=cfg.max_batch_rows,
         min_bucket_rows=cfg.min_bucket_rows,
         predict_kernel=cfg.predict_kernel,
-        replicas=cfg.serve_replicas)
+        replicas=cfg.serve_replicas,
+        failure_threshold=cfg.replica_failure_threshold)
     return PredictionServer(
         registry, host=cfg.serve_host, port=cfg.serve_port,
         max_batch_rows=cfg.max_batch_rows,
         flush_deadline_ms=cfg.flush_deadline_ms,
         model_poll_seconds=cfg.model_poll_seconds,
         max_pending_rows=cfg.max_pending_rows,
+        request_timeout_ms=cfg.serve_request_timeout_ms,
         default_raw=cfg.is_predict_raw_score)
 
 

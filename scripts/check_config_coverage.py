@@ -32,14 +32,12 @@ sys.path.insert(0, ROOT)
 # Config fields are otherwise required to be consumed somewhere.
 ALLOWLIST = {
     # reference-compat parameters with no TPU analog
-    "num_threads": "host threading is jax/XLA's concern on this backend",
     "is_enable_sparse": "no sparse store on TPU (SURVEY.md §7 start dense)",
     "sparse_threshold": "no sparse store on TPU",
     "gpu_platform_id": "OpenCL selector kept for config compatibility",
     "gpu_device_id": "OpenCL selector kept for config compatibility",
     "gpu_use_dp": "OpenCL precision dial; histogram_dtype is the analog",
     "time_out": "socket-network timeout; collectives have no knob here",
-    "output_freq": "CLI logging cadence not yet wired",
     # declared TPU knobs awaiting implementation
     "hist_dtype": "accumulation dtype override not yet implemented",
     "hist_input_dtype": "superseded by histogram_dtype; kept for compat",

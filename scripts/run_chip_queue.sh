@@ -59,6 +59,12 @@ stage bench_serve env BENCH_SANITIZE=1 SERVE_BENCH_SECONDS=10 SERVE_BENCH_OUT=.b
 # steady-state refits under the sanitizer (0 retraces / 0 implicit
 # transfers per refresh) — refreshes the committed artifact
 stage bench_online env BENCH_SANITIZE=1 BENCH_ONLINE_OUT=bench_online_measured.json python scripts/bench_online.py || exit 1
+# chaos drill: serve+online loop under deterministic injected faults
+# (replica outage -> breaker -> half-open readmit, daemon crash
+# mid-publish -> intent adopt, torn model file -> registry survives),
+# gated on bitwise answers, recovery, and 0 request-path compiles /
+# 0 retraces / 0 implicit transfers — refreshes the committed artifact
+stage bench_chaos env BENCH_SANITIZE=1 BENCH_CHAOS_OUT=bench_chaos_measured.json python scripts/bench_chaos.py || exit 1
 stage bench_narrow_off env LGBT_NARROW_ONEHOT=0 BENCH_ITERS=12 python bench.py || exit 1
 stage bench_part_off   env LGBT_FUSED_PARTITION=0 BENCH_ITERS=12 python bench.py || exit 1
 # 2. the 63-bin variant (VERDICT #2: reference accelerator sweet spot)

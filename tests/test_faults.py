@@ -1,0 +1,821 @@
+"""Chaos suite (docs/Robustness.md): deterministic fault injection at
+the train/serve/online seams, and the recovery contracts it proves.
+
+Every scenario is exactly reproducible: faults arm by (site, sequence)
+with no wall clock and no global RNG (diagnostics/faults.py), so a
+failing run's spec string IS its reproduction recipe.
+
+Contracts pinned here:
+
+- kill-and-resume parity: a training run killed at a checkpoint
+  boundary and resumed via ``checkpoint_path`` produces a BITWISE
+  identical model to the uninterrupted run (gbdt with bagging, goss,
+  dart — sampler RNG state rides in the checkpoint);
+- a torn checkpoint / state sidecar / traffic append (a crash artifact)
+  never wedges the restarted process — it logs and starts clean;
+- a killed-and-restarted online daemon resumes from its persisted
+  traffic offset: rows inside a published generation are never
+  re-processed, rows of the in-flight window land in exactly one
+  future publish (the publish-intent adopt/redo protocol);
+- under injected replica failures the serving fleet keeps answering:
+  failed chunks retry on a healthy replica with exact output, the
+  circuit breaker opens after ``replica_failure_threshold`` consecutive
+  failures and readmits through the half-open probe, zero healthy
+  replicas is HTTP 503 (not a raw 500) and a slow batch is HTTP 504.
+"""
+import http.client
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import profiling
+from lightgbm_tpu.config import config_from_params
+from lightgbm_tpu.diagnostics import faults
+from lightgbm_tpu.online import OnlineTrainer, append_traffic
+from lightgbm_tpu.serving import ModelRegistry, PredictorRuntime
+from lightgbm_tpu.serving.runtime import NoHealthyReplicaError
+
+pytestmark = [pytest.mark.quick, pytest.mark.chaos]
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _synth(n=1500, f=10, seed=5):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, f)
+    w = rng.randn(f)
+    z = X @ w
+    y = (z > np.median(z)).astype(np.float64)
+    return X, y
+
+
+# ---------------------------------------------------------------------------
+# the fault registry itself
+# ---------------------------------------------------------------------------
+
+
+def test_spec_parse_and_sequencing():
+    plan = faults.parse_spec("a:1,3-5;b:*;c")
+    assert plan == {"a": frozenset({1, 3, 4, 5}), "b": None, "c": None}
+    with pytest.raises(ValueError):
+        faults.parse_spec("a:0")            # sequences are 1-based
+    with pytest.raises(ValueError):
+        faults.parse_spec(":3")
+    faults.arm("site:2")
+    assert not faults.fire("site")          # hit 1: not armed
+    assert faults.fire("site")              # hit 2: armed
+    assert not faults.fire("site")          # hit 3
+    assert faults.hits("site") == 3 and faults.fired("site") == 1
+    # unarmed sites never count (and the fast path never locks)
+    assert not faults.fire("other")
+    assert faults.hits("other") == 0
+    snap = faults.snapshot()
+    assert snap["site"] == {"hits": 3, "fired": 1}
+
+
+def test_env_arming(monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR, "x.seam:1")
+    assert faults.arm_from_env()
+    with pytest.raises(faults.InjectedFault):
+        faults.check("x.seam")
+    faults.check("x.seam")                  # hit 2: disarmed, no raise
+
+
+def test_torn_write_leaves_half_the_payload(tmp_path):
+    p = str(tmp_path / "f.json")
+    faults.torn_write("t.site", p, "unfired")      # not armed: no-op
+    assert not os.path.exists(p)
+    faults.arm("t.site:1")
+    with pytest.raises(faults.InjectedFault):
+        faults.torn_write("t.site", p, '{"k": "0123456789"}')
+    blob = open(p).read()
+    assert 0 < len(blob) < len('{"k": "0123456789"}')
+    with pytest.raises(ValueError):
+        json.loads(blob)                    # genuinely torn
+
+
+# ---------------------------------------------------------------------------
+# training checkpoint / resume
+# ---------------------------------------------------------------------------
+
+
+def _ckpt_params(extra=None):
+    return {"objective": "binary", "verbose": -1, "num_leaves": 7,
+            "min_data_in_leaf": 5, "learning_rate": 0.5,
+            "deterministic": True, **(extra or {})}
+
+
+def _kill_and_resume(tmp_path, extra):
+    """10 rounds uninterrupted vs. killed-after-6 + resumed."""
+    X, y = _synth(500, 8, seed=7)
+    params = _ckpt_params(extra)
+    full = lgb.train(params, lgb.Dataset(X, y), num_boost_round=10)
+    ck = str(tmp_path / "ck.json")
+    p = dict(params, checkpoint_path=ck, checkpoint_interval=3)
+    # the "killed" run: snapshots land at iterations 3 and 6; training
+    # to 6 and stopping is exactly a kill at the checkpoint boundary
+    lgb.train(p, lgb.Dataset(X, y), num_boost_round=6)
+    resumed = lgb.train(p, lgb.Dataset(X, y), num_boost_round=10)
+    return full, resumed, X
+
+
+@pytest.mark.parametrize("extra", [
+    {"bagging_fraction": 0.8, "bagging_freq": 1, "seed": 3},
+    {"boosting": "goss", "top_rate": 0.3, "other_rate": 0.2, "seed": 3},
+], ids=["bagging", "goss"])
+def test_kill_and_resume_bitwise_parity(tmp_path, extra):
+    """The acceptance contract: bitwise-identical models.  The sampler
+    RNG state (bagging RandomState, GOSS jax key) rides in the
+    checkpoint — a re-seeded RNG would re-draw the first bags and fork
+    the run — and the resume replay adds the restored trees in exactly
+    training's f32 score-accumulation order (walk kernel)."""
+    full, resumed, _X = _kill_and_resume(tmp_path, extra)
+    assert resumed.model_to_string() == full.model_to_string()
+
+
+def test_kill_and_resume_dart_structure_exact(tmp_path):
+    """DART resumes with IDENTICAL tree structure and <= 1e-6 leaf
+    values — bitwise is impossible by construction: dropout removes and
+    re-adds scaled trees to the f32 training scores, an accumulation
+    HISTORY the resumed replay (one add per tree, final values) cannot
+    reproduce, so scores differ at ULP level (docs/Robustness.md).  The
+    drop RNG + tree weights DO ride in the checkpoint: the same trees
+    drop in the same iterations."""
+    full, resumed, X = _kill_and_resume(
+        tmp_path, {"boosting": "dart", "drop_rate": 0.5, "seed": 3})
+
+    def structure(bst):
+        return [(t.num_leaves, t.split_feature[: t.num_leaves - 1].tolist(),
+                 t.threshold[: t.num_leaves - 1].tolist())
+                for t in bst._gbdt.models]
+
+    assert structure(resumed) == structure(full)
+    for tf, tr in zip(full._gbdt.models, resumed._gbdt.models):
+        np.testing.assert_allclose(tr.leaf_value[: tr.num_leaves],
+                                   tf.leaf_value[: tf.num_leaves],
+                                   rtol=0, atol=1e-6)
+    np.testing.assert_allclose(resumed.predict(X), full.predict(X),
+                               rtol=0, atol=1e-5)
+
+
+def test_resume_restores_early_stopping_state(tmp_path):
+    """The CLI early-stopping bests (GBDT._early_stopping_state, fed by
+    eval_and_check_early_stopping the way task=train drives it) ride in
+    the checkpoint: the resumed run compares future iterations against
+    the ORIGINAL run's best metric, not a reset one."""
+    from lightgbm_tpu.boosting.gbdt import create_boosting, load_checkpoint
+    from lightgbm_tpu.dataset import Dataset as RawDataset
+    from lightgbm_tpu.objectives import create_objective
+    X, y = _synth(600, 8, seed=11)
+    cfg = config_from_params(_ckpt_params({"early_stopping_round": 50,
+                                           "metric": ("binary_logloss",)}))
+    train_ds = RawDataset(X[:400], y[:400].astype(np.float32), cfg)
+    ck = str(tmp_path / "ck.json")
+
+    def run(iters, start_state=None, checkpoint_at=None):
+        g = create_boosting(cfg)
+        obj = create_objective(cfg)
+        start = 0
+        if start_state is not None:
+            start = g.resume_from_checkpoint(start_state, train_ds, obj)
+        else:
+            g.reset_training_data(train_ds, obj)
+        g.add_valid(RawDataset(X[400:], y[400:].astype(np.float32), cfg,
+                               reference=train_ds), "v")
+        for _ in range(start, iters):
+            g.train_one_iter(None, None, is_eval=False)
+            g.eval_and_check_early_stopping(g.eval_valid())
+            if checkpoint_at is not None and g.iter_ == checkpoint_at:
+                g.save_checkpoint(ck)
+        return g
+
+    full = run(8)
+    run(4, checkpoint_at=4)                 # "killed" right after it 4
+    st = json.load(open(ck))
+    assert st["iteration"] == 4 and st["early_stopping"]
+    resumed = run(8, start_state=load_checkpoint(ck))
+    assert resumed._early_stopping_state == full._early_stopping_state
+    assert (resumed.save_model_to_string()
+            == full.save_model_to_string())
+
+
+def test_torn_checkpoint_never_wedges_the_restart(tmp_path):
+    """A crash mid-checkpoint-write (chaos seam train.checkpoint) tears
+    the file AT the destination path; the restarted run must log, ignore
+    it, and train from scratch — not crash, not resume garbage."""
+    X, y = _synth(400, 8, seed=9)
+    ck = str(tmp_path / "ck.json")
+    p = _ckpt_params({"checkpoint_path": ck, "checkpoint_interval": 2})
+    faults.arm("train.checkpoint:2")        # first lands, second tears
+    with pytest.raises(faults.InjectedFault):
+        lgb.train(p, lgb.Dataset(X, y), num_boost_round=10)
+    with pytest.raises(ValueError):
+        json.load(open(ck))                 # genuinely torn on disk
+    faults.reset()
+    fresh = lgb.train(p, lgb.Dataset(X, y), num_boost_round=5)
+    assert fresh.num_trees() == 5           # started clean
+    full = lgb.train(_ckpt_params(), lgb.Dataset(X, y), num_boost_round=5)
+    assert fresh.model_to_string() == full.model_to_string()
+
+
+def test_atomic_checkpoint_survives_crash_after_write(tmp_path):
+    """train.after_checkpoint kills the process right after a snapshot
+    landed (the tmp+rename already completed): the checkpoint on disk
+    must be complete and resumable."""
+    X, y = _synth(400, 8, seed=9)
+    ck = str(tmp_path / "ck.json")
+    p = _ckpt_params({"checkpoint_path": ck, "checkpoint_interval": 2})
+    faults.arm("train.after_checkpoint:2")  # die as iteration 4 lands
+    with pytest.raises(faults.InjectedFault):
+        lgb.train(p, lgb.Dataset(X, y), num_boost_round=10)
+    st = json.load(open(ck))
+    assert st["iteration"] == 4
+    faults.reset()
+    resumed = lgb.train(p, lgb.Dataset(X, y), num_boost_round=10)
+    full = lgb.train(_ckpt_params(), lgb.Dataset(X, y), num_boost_round=10)
+    assert resumed.model_to_string() == full.model_to_string()
+
+
+def test_checkpoint_fingerprint_rejects_recipe_change(tmp_path):
+    X, y = _synth(400, 8, seed=9)
+    ck = str(tmp_path / "ck.json")
+    p = _ckpt_params({"checkpoint_path": ck, "checkpoint_interval": 2})
+    lgb.train(p, lgb.Dataset(X, y), num_boost_round=4)
+    with pytest.raises(lgb.LightGBMError, match="fingerprint"):
+        lgb.train(dict(p, learning_rate=0.1), lgb.Dataset(X, y),
+                  num_boost_round=8)
+    # paths/verbosity/iteration count are NOT part of the recipe
+    from lightgbm_tpu.boosting.gbdt import config_fingerprint
+    a = config_fingerprint(config_from_params(p))
+    b = config_fingerprint(config_from_params(
+        dict(p, verbose=1, num_iterations=99,
+             output_model="elsewhere.txt")))
+    assert a == b
+
+
+def test_checkpoint_config_keys_and_aliases():
+    cfg = config_from_params({"checkpoint": "/tmp/c.json",
+                              "snapshot_freq": 25})
+    assert cfg.checkpoint_path == "/tmp/c.json"
+    assert cfg.checkpoint_interval == 25
+    with pytest.raises(ValueError):
+        config_from_params({"checkpoint_interval": -1})
+
+
+# ---------------------------------------------------------------------------
+# online daemon crash safety
+# ---------------------------------------------------------------------------
+
+
+def _daemon_setup(tmp_path, trigger=256):
+    X, y = _synth(1600, seed=21)
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 15,
+              "min_data_in_leaf": 5, "online_trigger_rows": trigger,
+              "refit_decay_rate": 0.0, "refit_min_rows": 1}
+    bst = lgb.train(params, lgb.Dataset(X[:1000], y[:1000]),
+                    num_boost_round=5)
+    init = str(tmp_path / "init.txt")
+    bst.save_model(init)
+    traffic = str(tmp_path / "traffic.jsonl")
+    pub = str(tmp_path / "pub.txt")
+    cfg = config_from_params(params)
+    tr = OnlineTrainer(bst, traffic, pub, config=cfg)
+    return tr, X, y, traffic, pub, init, cfg
+
+
+def _restart(tmp_path, traffic, pub, init, cfg):
+    """A cold daemon restart: a FRESH booster from the initial model
+    file (the dead process's in-memory state is gone), resume=True."""
+    bst = lgb.Booster(params={"verbose": -1}, model_file=init)
+    return OnlineTrainer(bst, traffic, pub, config=cfg)
+
+
+def test_daemon_restart_resumes_exact_offset(tmp_path):
+    """The acceptance contract: a killed-and-restarted daemon resumes
+    from its persisted offset — no row re-processed, no row skipped."""
+    tr, X, y, traffic, pub, init, cfg = _daemon_setup(tmp_path)
+    append_traffic(traffic, X[1000:1300], y[1000:1300])
+    assert tr.poll_once() is True           # generation 1: rows 0..300
+    assert json.load(open(pub + ".meta.json"))["rows"] == 300
+    offset1 = tr.traffic.offset
+    append_traffic(traffic, X[1300:1400], y[1300:1400])
+    assert tr.poll_once() is False          # 100 in flight, below trigger
+    # KILL (no drain, no state flush since the publish)
+    del tr
+    tr2 = _restart(tmp_path, traffic, pub, init, cfg)
+    assert tr2.generation == 1              # adopted the published gen
+    assert tr2.traffic.offset == offset1    # NOT 0: published rows skip
+    assert tr2.pending_rows() == 0          # in-flight rows re-read lazily
+    append_traffic(traffic, X[1400:1556], y[1400:1556])
+    assert tr2.poll_once() is True          # 100 re-read + 156 new
+    meta = json.load(open(pub + ".meta.json"))
+    assert meta["generation"] == 2
+    assert meta["rows"] == 256              # exactly-once: no dup, no gap
+    assert tr2.rows_seen == 556
+
+
+def test_daemon_restart_restores_frozen_mappers_bitwise(tmp_path):
+    """The refbin sidecar pins the frozen bin mappers across restarts:
+    a restarted daemon bins a chunk bitwise-identically to the original
+    daemon (a re-frozen mapper would quantize differently)."""
+    tr, X, y, traffic, pub, init, cfg = _daemon_setup(tmp_path)
+    append_traffic(traffic, X[1000:1300], y[1000:1300])
+    assert tr.poll_once() is True
+    # bin a probe chunk through the ORIGINAL frozen window
+    probe, py = X[1300:1400], y[1300:1400]
+    tr._window.append_rows(probe, py)
+    orig_bins = np.array(tr._window.bins[:, :100])
+    del tr
+    tr2 = _restart(tmp_path, traffic, pub, init, cfg)
+    assert tr2._window is not None          # restored, not None/pending
+    assert tr2._mapper_fp is not None
+    tr2._window.append_rows(probe, py)
+    np.testing.assert_array_equal(
+        np.array(tr2._window.bins[:, :100]), orig_bins)
+
+
+def test_crash_before_publish_redoes_the_window(tmp_path):
+    """online.before_publish kills the daemon after the refresh compute
+    but before any rename: nothing landed, so the restarted daemon
+    discards the publish intent and re-reads the whole window — the
+    rows land in exactly ONE publish, just a later one."""
+    tr, X, y, traffic, pub, init, cfg = _daemon_setup(tmp_path)
+    append_traffic(traffic, X[1000:1300], y[1000:1300])
+    faults.arm("online.before_publish:1")
+    with pytest.raises(faults.InjectedFault):
+        tr.poll_once()
+    faults.reset()
+    assert not os.path.exists(pub)          # nothing landed
+    del tr
+    tr2 = _restart(tmp_path, traffic, pub, init, cfg)
+    assert tr2.generation == 0              # intent discarded
+    assert tr2.traffic.offset == 0          # window re-reads from the log
+    assert tr2.poll_once() is True
+    meta = json.load(open(pub + ".meta.json"))
+    assert meta["generation"] == 1 and meta["rows"] == 300
+
+
+def test_crash_after_publish_adopts_the_intent(tmp_path):
+    """online.after_publish kills the daemon AFTER the model/meta
+    renames but BEFORE the state sidecar flush — the classic torn
+    two-phase commit.  The restarted daemon compares the write-ahead
+    intent against the published .meta.json, sees the publish landed,
+    and adopts it: those rows are inside the model and must NOT be
+    re-processed (double-refit)."""
+    tr, X, y, traffic, pub, init, cfg = _daemon_setup(tmp_path)
+    append_traffic(traffic, X[1000:1300], y[1000:1300])
+    faults.arm("online.after_publish:1")
+    with pytest.raises(faults.InjectedFault):
+        tr.poll_once()
+    faults.reset()
+    assert os.path.exists(pub)              # the publish DID land
+    offset_published = tr.traffic.offset
+    del tr
+    tr2 = _restart(tmp_path, traffic, pub, init, cfg)
+    assert tr2.generation == 1              # adopted
+    assert tr2.traffic.offset == offset_published
+    append_traffic(traffic, X[1300:1556], y[1300:1556])
+    assert tr2.poll_once() is True
+    meta = json.load(open(pub + ".meta.json"))
+    assert meta["generation"] == 2
+    assert meta["rows"] == 256              # ONLY the new rows
+
+
+def test_crash_between_renames_completes_the_publish(tmp_path):
+    """online.between_renames kills the daemon with the MODEL landed
+    but the meta not — the .meta.json generation alone cannot tell this
+    apart from nothing-landed, only the intent's staged-model sha1 can.
+    The restart must COMPLETE the publish (stage the meta recorded in
+    the intent) and adopt — re-refitting the window would double-apply
+    its rows to the already-refreshed model."""
+    tr, X, y, traffic, pub, init, cfg = _daemon_setup(tmp_path)
+    append_traffic(traffic, X[1000:1300], y[1000:1300])
+    faults.arm("online.between_renames:1")
+    with pytest.raises(faults.InjectedFault):
+        tr.poll_once()
+    faults.reset()
+    assert os.path.exists(pub)                      # model landed
+    assert not os.path.exists(pub + ".meta.json")   # meta did not
+    offset_published = tr.traffic.offset
+    del tr
+    tr2 = _restart(tmp_path, traffic, pub, init, cfg)
+    assert tr2.generation == 1                      # adopted
+    assert tr2.traffic.offset == offset_published   # rows NOT re-read
+    meta = json.load(open(pub + ".meta.json"))      # publish completed
+    assert meta["generation"] == 1 and meta["rows"] == 300
+    append_traffic(traffic, X[1300:1556], y[1300:1556])
+    assert tr2.poll_once() is True
+    meta = json.load(open(pub + ".meta.json"))
+    assert meta["generation"] == 2
+    assert meta["rows"] == 256                      # ONLY the new rows
+
+
+def test_torn_state_sidecar_never_wedges_restart(tmp_path):
+    """online.state_write tears the state sidecar mid-write (a crash
+    artifact at the destination path): the restarted daemon must log,
+    start fresh from offset 0, and still publish — never crash on the
+    corrupt JSON."""
+    tr, X, y, traffic, pub, init, cfg = _daemon_setup(tmp_path)
+    append_traffic(traffic, X[1000:1300], y[1000:1300])
+    faults.arm("online.state_write:1")      # the write-ahead intent flush
+    with pytest.raises(faults.InjectedFault):
+        tr.poll_once()
+    faults.reset()
+    with pytest.raises(ValueError):
+        json.load(open(pub + ".state.json"))    # genuinely torn
+    del tr
+    tr2 = _restart(tmp_path, traffic, pub, init, cfg)
+    assert tr2.generation == 0 and tr2.traffic.offset == 0
+    assert tr2.poll_once() is True          # fresh start still works
+    assert json.load(open(pub + ".meta.json"))["rows"] == 300
+
+
+def test_torn_traffic_append_absorbed_by_reader(tmp_path):
+    """traffic.append kills the WRITER mid-record: the torn tail sits
+    in the log until the next complete write, and the reader's
+    complete-lines-only contract skips exactly that one record."""
+    tr, X, y, traffic, pub, init, cfg = _daemon_setup(tmp_path)
+    append_traffic(traffic, X[1000:1100], y[1000:1100])
+    faults.arm("traffic.append:1")
+    with pytest.raises(faults.InjectedFault):
+        append_traffic(traffic, X[1100:1101], y[1100:1101])
+    faults.reset()
+    append_traffic(traffic, X[1101:1300], y[1101:1300])
+    assert tr.poll_once() is True
+    meta = json.load(open(pub + ".meta.json"))
+    # 100 + 198 complete rows; the torn half-record merged with the
+    # NEXT line parses as exactly one bad line (one row sacrificed,
+    # counted — never silently)
+    assert meta["rows"] == 298
+    assert tr.traffic.bad_lines == 1
+    assert meta["traffic"]["bad_lines"] == 1    # /stats-visible
+
+
+def test_sigterm_drain_flushes_state(tmp_path):
+    """run_forever with `stop` set drains: one final poll ingests what
+    already reached the log and the sidecar flushes, so the NEXT daemon
+    resumes exactly here with zero lost rows."""
+    tr, X, y, traffic, pub, init, cfg = _daemon_setup(tmp_path)
+    append_traffic(traffic, X[1000:1300], y[1000:1300])
+    stop = threading.Event()
+    stop.set()                              # "SIGTERM already delivered"
+    tr.run_forever(poll_seconds=0.01, stop=stop)
+    st = json.load(open(pub + ".state.json"))
+    assert st["generation"] == 1            # the drain poll published
+    assert st["published_offset"] == tr.traffic.offset
+    assert st["last_refresh"]["ok"] is True
+    del tr
+    tr2 = _restart(tmp_path, traffic, pub, init, cfg)
+    assert tr2.generation == 1 and tr2.pending_rows() == 0
+
+
+def test_failed_refresh_is_stats_visible(tmp_path):
+    """A refresh that throws must not kill the daemon loop AND must
+    leave evidence: last_refresh.ok=False with the exception in the
+    state sidecar (surfaced at /stats under online.daemon)."""
+    tr, X, y, traffic, pub, init, cfg = _daemon_setup(tmp_path)
+    append_traffic(traffic, X[1000:1300], y[1000:1300])
+    calls = {"n": 0}
+    orig = tr.refresh
+
+    def boom():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("synthetic refresh failure")
+        return orig()
+
+    tr.refresh = boom
+    stop = threading.Event()
+
+    def stopper():
+        stop.set()
+    t = threading.Timer(0.25, stopper)
+    t.start()
+    tr.run_forever(poll_seconds=0.05, stop=stop)
+    t.cancel()
+    st = json.load(open(pub + ".state.json"))
+    ref = st["last_refresh"]
+    assert calls["n"] >= 1
+    if not ref["ok"]:                       # drain retried successfully?
+        assert "synthetic refresh failure" in ref["error"]
+    else:
+        assert st["generation"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# serving replica failover
+# ---------------------------------------------------------------------------
+
+
+def _fleet(replicas=2, threshold=2, probe_after=3, rounds=4):
+    X, y = _synth(800, seed=33)
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "num_leaves": 15, "min_data_in_leaf": 5},
+                    lgb.Dataset(X, y), num_boost_round=rounds)
+    rt = PredictorRuntime(bst, max_batch_rows=128,
+                          replicas=replicas,
+                          failure_threshold=threshold,
+                          probe_after=probe_after)
+    rt.warmup([64, 128])
+    return rt, bst, X
+
+
+def test_failed_chunk_retries_on_healthy_replica_exact():
+    rt, bst, X = _fleet(replicas=2, threshold=10)
+    expected = rt.predict(X[:64])           # the healthy fleet's output
+    faults.arm("serve.dispatch.r0")         # replica 0 always throws
+    for _ in range(6):
+        got = rt.predict(X[:64])
+        np.testing.assert_array_equal(got, expected)   # retry is EXACT
+    assert rt.chunk_retries >= 1            # r0 was picked at least once
+    assert faults.fired("serve.dispatch.r0") == rt.chunk_retries
+    misses = rt.cache_misses
+    rt.predict(X[:64])
+    assert rt.cache_misses == misses        # retries never compile
+
+
+def test_circuit_breaker_opens_and_traffic_continues():
+    # probe_after=100: no half-open probe interferes in this test
+    rt, bst, X = _fleet(replicas=2, threshold=2, probe_after=100)
+    expected = rt.predict(X[:64])
+    faults.arm("serve.dispatch.r0")
+    for _ in range(8):
+        np.testing.assert_array_equal(rt.predict(X[:64]), expected)
+    health = {h["index"]: h for h in rt.replica_health()}
+    assert health[0]["state"] == "broken"
+    assert health[1]["state"] == "healthy"
+    assert rt.healthy_count() == 1
+    # broken means ROUTED AROUND: no further faults fire at r0's seam
+    fired = faults.fired("serve.dispatch.r0")
+    np.testing.assert_array_equal(rt.predict(X[:64]), expected)
+    assert faults.fired("serve.dispatch.r0") == fired
+
+
+def test_half_open_probe_readmits_recovered_replica():
+    rt, bst, X = _fleet(replicas=2, threshold=1, probe_after=3)
+    expected = rt.predict(X[:64])
+    faults.arm("serve.dispatch.r0")
+    for _ in range(4):
+        rt.predict(X[:64])
+    assert rt.healthy_count() == 1
+    faults.disarm()                         # "the replica recovered"
+    for _ in range(8):                      # route-arounds reach the
+        np.testing.assert_array_equal(     # probe threshold, then one
+            rt.predict(X[:64]), expected)  # live request probes r0
+    health = {h["index"]: h for h in rt.replica_health()}
+    assert health[0]["state"] == "healthy"  # readmitted
+    assert health[0]["probes"] >= 1
+    assert rt.healthy_count() == 2
+    # and a FAILED probe re-opens for another window without hurting
+    # the probing client
+    faults.arm("serve.dispatch.r0")
+    for _ in range(12):
+        np.testing.assert_array_equal(rt.predict(X[:64]), expected)
+    health = {h["index"]: h for h in rt.replica_health()}
+    assert health[0]["state"] == "broken"
+    assert health[0]["probes"] >= 1
+
+
+def test_retry_never_consumed_as_half_open_probe():
+    """A failed chunk's single retry must land on a HEALTHY replica:
+    spending it on a broken replica's half-open probe would fail the
+    request while healthy capacity sits idle.  Two of three replicas
+    stay broken and probe-eligible on EVERY pick (probe_after=1); a
+    first attempt may burn on a probe, but its retry reaches r2."""
+    rt, bst, X = _fleet(replicas=3, threshold=1, probe_after=1)
+    expected = rt.predict(X[:64])
+    faults.arm("serve.dispatch:1-2")        # first pick AND its retry
+    with pytest.raises(faults.InjectedFault):
+        rt.predict(X[:64])                  # breaks two replicas
+    assert rt.healthy_count() == 1
+    # keep the two broken replicas throwing; both are probe-eligible on
+    # EVERY pick (probe_after=1)
+    faults.arm(";".join(f"serve.dispatch.r{h['index']}"
+                        for h in rt.replica_health()
+                        if h["state"] == "broken"))
+    # every request: the first attempt may burn on a broken replica's
+    # half-open probe, but its RETRY must land on the healthy replica —
+    # never on the OTHER broken one's probe; the client always answers
+    for _ in range(6):
+        np.testing.assert_array_equal(rt.predict(X[:64]), expected)
+    assert rt.healthy_count() == 1
+
+
+def test_zero_healthy_replicas_raises_no_healthy():
+    rt, bst, X = _fleet(replicas=2, threshold=1)
+    faults.arm("serve.dispatch")            # EVERY replica throws
+    with pytest.raises(faults.InjectedFault):
+        rt.predict(X[:64])                  # breaks both on the way down
+    assert rt.healthy_count() == 0
+    with pytest.raises(NoHealthyReplicaError):
+        rt.predict(X[:64])
+
+
+def test_single_replica_fleet_surfaces_real_error():
+    """With one replica and the breaker not yet open, the retry's
+    exclusion empties the pool — the REAL error must surface, not a
+    misleading no-healthy-replica message."""
+    rt, bst, X = _fleet(replicas=1, threshold=5)
+    faults.arm("serve.dispatch:1")
+    with pytest.raises(faults.InjectedFault):
+        rt.predict(X[:64])
+    # next request succeeds (fault was one-shot, breaker never opened)
+    assert rt.predict(X[:64]).shape == (64,)
+
+
+def test_registry_wires_failure_threshold(tmp_path):
+    X, y = _synth(600, seed=41)
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "num_leaves": 7}, lgb.Dataset(X, y),
+                    num_boost_round=3)
+    pub = str(tmp_path / "m.txt")
+    bst.save_model(pub)
+    reg = ModelRegistry(pub, params={"verbose": -1}, max_batch_rows=64,
+                        failure_threshold=7)
+    assert reg.current().failure_threshold == 7
+    cfg = config_from_params({"serve_failure_threshold": 4})
+    assert cfg.replica_failure_threshold == 4
+    with pytest.raises(ValueError):
+        config_from_params({"replica_failure_threshold": 0})
+
+
+# ---------------------------------------------------------------------------
+# torn model files at the registry (satellite: no tmp+rename discipline)
+# ---------------------------------------------------------------------------
+
+
+def test_registry_poll_survives_torn_model_and_records_it(tmp_path):
+    X, y = _synth(600, seed=41)
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "num_leaves": 7}, lgb.Dataset(X, y),
+                    num_boost_round=3)
+    pub = str(tmp_path / "m.txt")
+    bst.save_model(pub)
+    reg = ModelRegistry(pub, params={"verbose": -1}, max_batch_rows=64)
+    p0 = reg.current().predict(X[:32])
+    before = profiling.counter_value(profiling.REGISTRY_SWAP_FAILURES)
+    # a publisher WITHOUT the tmp+rename discipline dies mid-write:
+    # the poll meets a half model file at the final path
+    blob = bst.model_to_string()
+    with open(pub, "w") as f:
+        f.write(blob[: len(blob) // 2])
+    assert reg.maybe_reload(force=True) is False
+    assert reg.generation == 1              # old generation kept serving
+    assert reg.swap_failures == 1
+    assert reg.last_swap_error              # class+message recorded
+    assert (profiling.counter_value(profiling.REGISTRY_SWAP_FAILURES)
+            == before + 1)
+    np.testing.assert_array_equal(reg.current().predict(X[:32]), p0)
+    # the repaired (atomic) publish swaps cleanly and clears the error
+    bst.save_model(pub + ".tmp")
+    os.replace(pub + ".tmp", pub)
+    assert reg.maybe_reload(force=True) is True
+    assert reg.generation == 2 and reg.last_swap_error is None
+
+
+def test_online_torn_publish_end_to_end(tmp_path):
+    """The chaos seam online.publish_model writes HALF the model at the
+    publish path, then the daemon dies.  The serving registry keeps the
+    old generation; the restarted daemon redoes the window and the next
+    (atomic) publish swaps in cleanly."""
+    tr, X, y, traffic, pub, init, cfg = _daemon_setup(tmp_path)
+    # generation 1 publishes cleanly and serves
+    append_traffic(traffic, X[1000:1300], y[1000:1300])
+    assert tr.poll_once() is True
+    reg = ModelRegistry(pub, params={"verbose": -1}, max_batch_rows=64)
+    assert reg.generation == 1
+    p1 = reg.current().predict(X[:32])
+    # generation 2's publish tears the model file mid-write
+    append_traffic(traffic, X[1300:1600], y[1300:1600])
+    faults.arm("online.publish_model:1")
+    with pytest.raises(faults.InjectedFault):
+        tr.poll_once()
+    faults.reset()
+    assert reg.maybe_reload(force=True) is False    # torn file rejected
+    assert reg.swap_failures == 1 and reg.last_swap_error
+    np.testing.assert_array_equal(reg.current().predict(X[:32]), p1)
+    del tr
+    tr2 = _restart(tmp_path, traffic, pub, init, cfg)
+    assert tr2.generation == 1              # gen 2 never landed: redo
+    assert tr2.poll_once() is True
+    assert json.load(open(pub + ".meta.json"))["generation"] == 2
+    assert reg.maybe_reload() is True       # the clean publish swaps
+    # registry generation counts ITS swaps: 1 at load, 2 now
+    assert reg.generation == 2 and reg.last_swap_error is None
+
+
+# ---------------------------------------------------------------------------
+# HTTP status mapping: 503 on zero-healthy, 504 on timeout
+# ---------------------------------------------------------------------------
+
+
+def _http(srv, method, path, body=None):
+    """(status, payload): a 200 /predict body is JSON-LINES (one doc
+    per prediction row) — return the parsed first line; errors and GET
+    endpoints are single JSON objects."""
+    conn = http.client.HTTPConnection(srv.host, srv.port, timeout=30)
+    try:
+        conn.request(method, path, body=body,
+                     headers={"Content-Type": "application/json"})
+        r = conn.getresponse()
+        text = r.read().decode()
+        first = text.strip().splitlines()[0] if text.strip() else "{}"
+        return r.status, json.loads(first)
+    finally:
+        conn.close()
+
+
+def _server(tmp_path, **kw):
+    from lightgbm_tpu.serving.server import PredictionServer
+    X, y = _synth(600, seed=41)
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "num_leaves": 7, "min_data_in_leaf": 5},
+                    lgb.Dataset(X, y), num_boost_round=3)
+    pub = str(tmp_path / "m.txt")
+    bst.save_model(pub)
+    reg = ModelRegistry(pub, params={"verbose": -1}, max_batch_rows=64,
+                        replicas=kw.pop("replicas", 1),
+                        failure_threshold=kw.pop("failure_threshold", 3))
+    srv = PredictionServer(reg, host="127.0.0.1", port=0,
+                           max_batch_rows=64, **kw)
+    srv.start()
+    return srv, X
+
+
+def test_server_503_when_zero_replicas_healthy(tmp_path):
+    srv, X = _server(tmp_path, replicas=2, failure_threshold=1,
+                     flush_deadline_ms=1.0)
+    try:
+        body = json.dumps({"rows": X[:4].tolist()})
+        status, _ = _http(srv, "POST", "/predict", body)
+        assert status == 200
+        faults.arm("serve.dispatch")        # every dispatch throws
+        status, _ = _http(srv, "POST", "/predict", body)
+        assert status == 500                # the breaking request
+        faults.disarm()                     # replicas STAY broken
+        status, out = _http(srv, "POST", "/predict", body)
+        assert status == 503                # shed load, retryable
+        assert "healthy" in out["error"]
+        st = _http(srv, "GET", "/stats")[1]
+        assert st["replicas"]["healthy"] == 0
+        assert st["replicas"]["broken_total"] >= 2
+        assert all(h["state"] == "broken"
+                   for h in st["replicas"]["health"])
+    finally:
+        srv.stop()
+        faults.reset()
+
+
+def test_server_504_on_request_timeout(tmp_path):
+    """serve_request_timeout_ms bounds the waiter: a batch that has not
+    scored in time answers 504 (retry with backoff), not a raw 500."""
+    srv, X = _server(tmp_path, flush_deadline_ms=5000.0,
+                     request_timeout_ms=40.0)
+    try:
+        # a single row never fills the 64-row batch; the 5 s flush
+        # deadline guarantees the 40 ms waiter times out first
+        body = json.dumps({"rows": X[:1].tolist()})
+        status, out = _http(srv, "POST", "/predict", body)
+        assert status == 504
+        assert "serve_request_timeout_ms" in out["error"]
+        st = _http(srv, "GET", "/stats")[1]
+        assert st["timeouts"] >= 1
+    finally:
+        srv.stop()
+
+
+def test_server_stats_surface_daemon_state_and_traffic(tmp_path):
+    tr, X, y, traffic, pub, init, cfg = _daemon_setup(tmp_path)
+    with open(traffic, "w") as f:
+        f.write("garbage\n")                # one bad line, /stats-visible
+    append_traffic(traffic, X[1000:1300], y[1000:1300])
+    assert tr.poll_once() is True
+    stop = threading.Event()
+    stop.set()
+    tr.run_forever(poll_seconds=0.01, stop=stop)   # flush state
+    from lightgbm_tpu.serving.server import PredictionServer
+    reg = ModelRegistry(pub, params={"verbose": -1}, max_batch_rows=64)
+    srv = PredictionServer(reg, host="127.0.0.1", port=0)
+    st = srv.stats()
+    online = st["online"]
+    assert online["generation"] == 1
+    assert online["traffic"]["bad_lines"] == 1     # silent loss, visible
+    assert online["daemon"]["published_offset"] == tr.traffic.offset
+    assert online["daemon"]["last_refresh"]["ok"] is True
+    assert online["daemon"]["traffic"]["rows_read"] == 300
+
+
+def test_serve_timeout_config_key_and_alias():
+    cfg = config_from_params({"request_timeout_ms": 2500})
+    assert cfg.serve_request_timeout_ms == 2500
+    with pytest.raises(ValueError):
+        config_from_params({"serve_request_timeout_ms": 0})
