@@ -329,6 +329,31 @@ def main():
         "bundles": 0 if plan is None else plan.num_bundles,
         "realized_conflict_rate": round(inner.realized_conflict_rate(), 6),
     }
+    # ingestion accounting (sharded/ingest.py): this process's peak RSS
+    # high-water mark, plus — when BENCH_STREAM_CHUNK_ROWS is set — a
+    # timed `Dataset.from_stream` construction of the same data at that
+    # chunk size (the A/B across env values; scripts/bench_ingest.py
+    # measures the controlled matrix in fresh processes so each
+    # configuration owns its ru_maxrss)
+    import resource
+    ingest = {"peak_rss_mb": round(
+        resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0, 1)}
+    scr = os.environ.get("BENCH_STREAM_CHUNK_ROWS", "")
+    if scr:
+        from lightgbm_tpu.config import config_from_params
+        from lightgbm_tpu.dataset import Dataset as RawDataset
+        icfg = config_from_params({"verbose": -1,
+                                   "stream_chunk_rows": int(scr)})
+        t_ing = time.perf_counter()
+        sds = RawDataset.from_stream((X, y), icfg)
+        ingest.update({
+            "stream_chunk_rows": int(scr),
+            "ingest_seconds": round(time.perf_counter() - t_ing, 3),
+            "streamed_rows": int(sds.num_data),
+            "sketch_exact": bool(getattr(sds, "_sketch_exact", False)),
+        })
+        del sds
+
     out = {
         "metric": f"synthetic-{WORKLOAD} {ROWS}x{X.shape[1]} gbdt "
                   f"{LEAVES} leaves, {BINS} bins: train seconds/iter",
@@ -344,6 +369,7 @@ def main():
         "hist_exchange": getattr(bst._gbdt.learner, "hist_exchange", "n/a"),
         "hist_exchange_bytes_per_iter": round(hx_bytes_per_iter, 1),
         "split_records_bytes_per_iter": round(sr_bytes_per_iter, 1),
+        "ingest": ingest,
         "kernel_flags": {
             "narrow_onehot": bool(_h.NARROW_ONEHOT),
             "fused_partition": bool(_p.FUSED_PARTITION),

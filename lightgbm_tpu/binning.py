@@ -236,8 +236,23 @@ def find_bin(sample_values: np.ndarray, total_sample_cnt: int, max_bin: int,
 
     Mirrors reference BinMapper::FindBin (bin.cpp:67-240).
     """
-    m = BinMapper(bin_type=bin_type)
     vals, counts = _distinct_with_zero(sample_values, total_sample_cnt)
+    return find_bin_from_distinct(vals, counts, total_sample_cnt, max_bin,
+                                  min_data_in_bin, min_split_data, bin_type)
+
+
+def find_bin_from_distinct(vals: np.ndarray, counts: np.ndarray,
+                           total_sample_cnt: int, max_bin: int,
+                           min_data_in_bin: int = 3, min_split_data: int = 20,
+                           bin_type: int = NUMERICAL) -> BinMapper:
+    """BinMapper from an already-built distinct-value summary (sorted
+    `vals` with per-value `counts`, zero already injected).  The body of
+    `find_bin`, exposed so the mergeable quantile sketches
+    (sharded/sketch.py) can reuse the exact same greedy boundary logic
+    on their weighted summaries — a sketch that still holds every
+    distinct value yields the bitwise-identical mapper."""
+    m = BinMapper(bin_type=bin_type)
+    counts = np.asarray(counts, np.int64)
     m.min_val, m.max_val = float(vals[0]), float(vals[-1])
 
     if bin_type == NUMERICAL:

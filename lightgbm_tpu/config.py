@@ -145,6 +145,15 @@ PARAM_ALIASES: Dict[str, str] = {
     "row_partition": "hist_rows",
     # data-parallel histogram exchange (docs/Readme.md "Histogram exchange")
     "histogram_reduce": "hist_exchange",
+    "hist_exchange_threshold": "hist_exchange_min_bytes",
+    "histogram_exchange_min_bytes": "hist_exchange_min_bytes",
+    # pod-scale data plane (docs/Distributed-Data.md, lightgbm_tpu/sharded/)
+    "bin_finding": "bin_find",
+    "distributed_bin_find": "bin_find",
+    "quantile_sketch_eps": "sketch_eps",
+    "sketch_epsilon": "sketch_eps",
+    "stream_chunk_size": "stream_chunk_rows",
+    "ingest_chunk_rows": "stream_chunk_rows",
 }
 
 # objective name aliases (reference config.cpp GetObjectiveType handling)
@@ -239,6 +248,24 @@ class Config:
     bin_construct_sample_cnt: int = 200000
     sparse_threshold: float = 0.8
     min_data_in_bin: int = 3
+    # distributed / out-of-core bin finding (docs/Distributed-Data.md):
+    # "allgather" derives mappers from the process-allgathered global
+    # sample (the validated PR-era path); "sketch" merges per-host (and
+    # per-chunk) mergeable quantile sketches so no host ever
+    # materializes the global sample — boundaries hold an eps rank
+    # guarantee (`sketch_eps`).  "auto" = the exact allgather path while
+    # the global sample fits `bin_construct_sample_cnt`, sketch beyond.
+    bin_find: str = "auto"
+    # rank-error knob of the mergeable quantile sketch: each sketch
+    # keeps O(1/eps) weighted entries per feature; smaller eps = tighter
+    # boundaries, bigger summaries.  Tight enough that the summary holds
+    # every distinct value, the sketch is EXACT (bitwise the allgather
+    # boundaries).
+    sketch_eps: float = 0.001
+    # row-chunk size of streamed dataset construction
+    # (Dataset.from_stream / use_two_round_loading): peak host memory of
+    # ingestion scales with this, not with the dataset length.
+    stream_chunk_rows: int = 262144
     # Exclusive Feature Bundling: pack mutually-exclusive features into
     # shared histogram columns (docs/Bundling.md).  max_conflict_rate is
     # the tolerated fraction of rows where two bundled features are both
@@ -323,6 +350,13 @@ class Config:
     # the extra record exchange, psum for small payloads (the reference's
     # allgather-vs-halving switch).
     hist_exchange: str = "auto"
+    # `hist_exchange=auto` switches to psum_scatter only when the
+    # per-pass reduced-histogram payload is at least this many bytes
+    # (below it the full psum is cheaper than reduce-scatter + the
+    # per-leaf record allgather).  -1 = the built-in default (1 MiB, or
+    # the LGBT_HIST_EXCHANGE_MIN_BYTES env override for on-chip tuning);
+    # >= 0 pins the crossover explicitly.
+    hist_exchange_min_bytes: int = -1
 
     # -- network (config.h:245-252)
     num_machines: int = 1
@@ -507,6 +541,16 @@ def check_param_conflict(cfg: Config) -> None:
         raise ValueError(f"unknown hist_rows: {cfg.hist_rows}")
     if cfg.hist_exchange not in ("auto", "psum", "psum_scatter"):
         raise ValueError(f"unknown hist_exchange: {cfg.hist_exchange}")
+    if cfg.hist_exchange_min_bytes < -1:
+        raise ValueError("hist_exchange_min_bytes must be >= 0, or -1 "
+                         "for the built-in default")
+    if cfg.bin_find not in ("auto", "allgather", "sketch"):
+        raise ValueError(f"unknown bin_find: {cfg.bin_find}; "
+                         "use auto, allgather or sketch")
+    if not (0.0 < cfg.sketch_eps < 0.5):
+        raise ValueError("sketch_eps must be in (0, 0.5)")
+    if cfg.stream_chunk_rows < 1:
+        raise ValueError("stream_chunk_rows must be >= 1")
     if not (0 <= cfg.serve_port <= 65535):
         raise ValueError("serve_port must be in [0, 65535]")
     if cfg.max_batch_rows < 1:

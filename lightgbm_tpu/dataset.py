@@ -334,7 +334,7 @@ def _query_boundaries_from_ids(qid: np.ndarray) -> np.ndarray:
 
 def load_file_two_round(path: str, cfg: Config,
                         reference: Optional["Dataset"] = None,
-                        chunk_rows: int = 262_144) -> "Dataset":
+                        chunk_rows: int = 0) -> "Dataset":
     """Streaming two-round ingestion for bigger-than-RAM text files
     (reference DatasetLoader two-round mode, dataset_loader.cpp:159-216):
 
@@ -350,6 +350,9 @@ def load_file_two_round(path: str, cfg: Config,
     """
     import pandas as pd
 
+    # the shared ingestion chunk knob (docs/Distributed-Data.md): peak
+    # parse memory of both streaming loaders scales with this, not N
+    chunk_rows = chunk_rows or int(cfg.stream_chunk_rows)
     label_idx = 0
     if cfg.label_column.startswith("name:"):
         raise NotImplementedError("label by name requires header support")
@@ -523,11 +526,24 @@ class Dataset:
             self.used_features = reference.used_features
             plan = reference.bundle_plan
         else:
-            self.mappers = find_bin_mappers(
-                X, cfg.max_bin, cfg.min_data_in_bin, cfg.min_data_in_leaf,
-                categorical=categorical_feature,
-                sample_cnt=cfg.bin_construct_sample_cnt,
-                seed=cfg.data_random_seed)
+            if cfg.bin_find == "sketch":
+                # explicit sketch opt-in: mappers from the mergeable
+                # quantile summaries over ALL rows (exact whenever eps
+                # is tight enough to hold every distinct value) — the
+                # same derivation the distributed and streamed
+                # construction paths run, so tree parity with those
+                # paths is testable from the batch API
+                from .sharded.sketch import sketch_columns
+                self.mappers = sketch_columns(
+                    X, cfg, categorical=categorical_feature
+                ).mappers_from_config(cfg)
+            else:
+                self.mappers = find_bin_mappers(
+                    X, cfg.max_bin, cfg.min_data_in_bin,
+                    cfg.min_data_in_leaf,
+                    categorical=categorical_feature,
+                    sample_cnt=cfg.bin_construct_sample_cnt,
+                    seed=cfg.data_random_seed)
             self.used_features = [i for i, m in enumerate(self.mappers)
                                   if not m.is_trivial]
             plan = _plan_bundles_from_sample(X, self.mappers,
@@ -664,6 +680,28 @@ class Dataset:
         """Allocated row slots of the store (== num_data except for
         streaming datasets, whose store grows in capacity tiers)."""
         return int(self.bins.shape[1])
+
+    @classmethod
+    def from_stream(cls, chunks, config: Optional[Config] = None,
+                    reference: Optional["Dataset"] = None,
+                    feature_names: Optional[List[str]] = None,
+                    categorical_feature: Sequence[int] = (),
+                    capacity: int = 0) -> "Dataset":
+        """Out-of-core streamed construction (sharded/ingest.py): a
+        sketch pass over the chunk stream derives the bin mappers, then
+        each chunk bins straight into the capacity-tiered store — peak
+        host memory scales with `stream_chunk_rows`, not the dataset
+        length, and while the data fits the sample budget the result is
+        BITWISE the batch construction.  `chunks` is a callable
+        returning a fresh iterator of (X, y, w) tuples, a list of such
+        tuples, or an (X, y[, w]) array tuple; `reference` skips the
+        sketch pass and bins against frozen mappers (the online-window
+        path)."""
+        from .sharded.ingest import dataset_from_stream
+        return dataset_from_stream(
+            chunks, config=config, reference=reference,
+            feature_names=feature_names,
+            categorical_feature=categorical_feature, capacity=capacity)
 
     @classmethod
     def streaming_from(cls, reference: "Dataset",
@@ -940,10 +978,18 @@ class Dataset:
     BINARY_MAGIC = "lightgbm_tpu.dataset.v3"
 
     def save_binary(self, path: str) -> None:
-        """Serialize the binned dataset so reloads skip parse+bin."""
+        """Serialize the binned dataset so reloads skip parse+bin.
+
+        A streaming dataset's capacity slack (store columns past
+        num_data) is trimmed on the way out, so the cache round-trips
+        as a normal dataset — bitwise the store a batch construction of
+        the same rows would write — instead of freezing one run's
+        capacity tier into the file."""
         md = self.metadata
         arrays = {
-            "bins": self.bins,
+            "bins": (self.bins if self.bins.shape[1] == self.num_data
+                     else np.ascontiguousarray(
+                         self.bins[:, : self.num_data])),
             "num_data": np.int64(self.num_data),
             "num_total_features": np.int64(self.num_total_features),
             "used_features": np.asarray(self.used_features, np.int64),

@@ -185,43 +185,134 @@ def allgather_f64(arr) -> "np.ndarray":
     return out.view(np.float64)
 
 
+def resolve_bin_find(cfg, n_sample_global: int, world: int = 1) -> str:
+    """Resolve the `bin_find` knob to the path distributed bin finding
+    runs.  "allgather" is the validated exact path (every rank derives
+    mappers from the identical allgathered global sample);  "sketch"
+    merges per-host quantile summaries (sharded/sketch.py) so no host
+    ever materializes the global sample.  "auto" stays exact while the
+    combined sample fits the bin-construction budget — the
+    pre-partition loader caps each rank at `budget // world + 1` rows,
+    so the `+ world` slack keeps its combined sample INSIDE the exact
+    path (default distributed binning stays the validated allgather;
+    sketches engage only when a caller feeds samples genuinely beyond
+    the budget, or explicitly via bin_find=sketch)."""
+    mode = getattr(cfg, "bin_find", "auto")
+    if mode == "auto":
+        budget = int(cfg.bin_construct_sample_cnt) + max(int(world), 1)
+        return "sketch" if n_sample_global > budget else "allgather"
+    return mode
+
+
+def _gathered_sizes(n_local: int) -> "np.ndarray":
+    import numpy as np
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(
+        np.array([n_local], np.int64))).reshape(-1)
+
+
+def _allgather_rows(local_rows, smax: int, sizes) -> "np.ndarray":
+    """Allgather variable-length row blocks (padded to `smax`, sliced
+    back by the true sizes) into one concatenated array."""
+    import numpy as np
+    padded = np.zeros((smax, local_rows.shape[1]), np.float64)
+    padded[: len(local_rows)] = local_rows
+    gathered = allgather_f64(padded)                      # [W, smax, F]
+    return np.concatenate([gathered[w, : int(sizes[w])]
+                           for w in range(gathered.shape[0])])
+
+
+def find_bin_mappers_sketch(local_sample, cfg, categorical=(),
+                            return_sample=False):
+    """Global BinMappers by MERGING per-host quantile sketches — the
+    distributed bin finding of the reference's Network layer
+    (dataset_loader.cpp:733-833) rebuilt on mergeable summaries
+    (arXiv:1706.08359 §4, arXiv:1806.11248 §5): each host summarizes
+    its local sample into O(F / eps) weighted entries, ONE small
+    allgather exchanges the fixed-width summaries, and every rank
+    derives identical mappers from the deterministic rank-order merge.
+    No host ever materializes the global sample.
+
+    return_sample=True returns a BOUNDED plan sample alongside (for the
+    EFB bundle planner, which needs row-level co-occurrence): each rank
+    contributes at most BUNDLE_PLAN_SAMPLE_CNT / world rows, so the
+    gathered sample is O(50k) rows regardless of the dataset — never
+    the global sample."""
+    import jax
+    import numpy as np
+    from .sharded.sketch import SketchSet, sketch_columns
+
+    world = jax.process_count()
+    ss = sketch_columns(local_sample, cfg, categorical=categorical)
+    if world > 1:
+        packed = ss.pack()                     # [F+1, 2*cap+4]
+        stack = allgather_f64(packed)          # [W, F+1, 2*cap+4]
+        ss = SketchSet.merge_packed(stack, categorical=categorical)
+    mappers = ss.mappers_from_config(cfg)
+    if not return_sample:
+        return mappers
+    from .dataset import BUNDLE_PLAN_SAMPLE_CNT
+    cap = max(BUNDLE_PLAN_SAMPLE_CNT // max(world, 1), 1)
+    plan_local = np.ascontiguousarray(
+        np.asarray(local_sample, np.float64)[:cap])
+    if world > 1:
+        sizes = _gathered_sizes(len(plan_local))
+        plan_sample = _allgather_rows(plan_local, int(sizes.max()), sizes)
+    else:
+        plan_sample = plan_local
+    return mappers, plan_sample
+
+
 def find_bin_mappers_distributed(local_sample, cfg, categorical=(),
                                  return_sample=False):
     """Global BinMappers from per-process local samples.
 
-    The reference shards FEATURES across machines, finds local mappers,
-    and allgathers the serialized results (dataset_loader.cpp:733-833).
-    Here the sample rows are allgathered instead (one collective on a
-    [S, F] float array) and every process derives identical mappers from
-    the identical global sample — no mapper serialization format needed,
-    determinism by construction.
+    Two paths behind the `bin_find` knob (resolve_bin_find):
 
-    return_sample=True also returns the identical-on-every-rank global
-    sample, so rank-consistent derived decisions (the EFB bundle plan)
-    can be computed from it without a second collective."""
+    - "allgather" (the validated exact path): the sample rows are
+      allgathered (one collective on a [S, F] float array) and every
+      process derives identical mappers from the identical global
+      sample — no mapper serialization format needed, determinism by
+      construction.  The reference instead shards FEATURES across
+      machines and allgathers serialized mappers
+      (dataset_loader.cpp:733-833).
+    - "sketch": per-host mergeable quantile summaries exchanged in ONE
+      O(F / eps) collective (find_bin_mappers_sketch) — the path that
+      scales past the sample budget, because no host ever holds the
+      global sample.
+
+    return_sample=True also returns an identical-on-every-rank sample,
+    so rank-consistent derived decisions (the EFB bundle plan) can be
+    computed from it without a second collective — the full global
+    sample on the allgather path, a bounded O(50k)-row plan sample on
+    the sketch path."""
     import jax
     import numpy as np
     from .binning import find_bin_mappers
 
-    if jax.process_count() == 1:
+    world = jax.process_count()
+    if world > 1:
+        sizes = _gathered_sizes(len(local_sample))
+        n_global = int(sizes.sum())
+    else:
+        sizes = np.array([len(local_sample)], np.int64)
+        n_global = len(local_sample)
+    if resolve_bin_find(cfg, n_global, world) == "sketch":
+        return find_bin_mappers_sketch(local_sample, cfg,
+                                       categorical=categorical,
+                                       return_sample=return_sample)
+
+    if world == 1:
         m = find_bin_mappers(
             local_sample, cfg.max_bin, cfg.min_data_in_bin,
             cfg.min_data_in_leaf, categorical=categorical,
             sample_cnt=len(local_sample), seed=cfg.data_random_seed)
         return (m, local_sample) if return_sample else m
-    from jax.experimental import multihost_utils
 
     # pad local samples to one shape (process sample sizes can differ by
     # one chunk); true per-process sizes travel alongside so padding rows
     # are sliced away exactly (no sentinel values — data may contain any)
-    sizes = multihost_utils.process_allgather(
-        np.array([len(local_sample)], np.int64)).reshape(-1)
-    smax = int(sizes.max())
-    padded = np.zeros((smax, local_sample.shape[1]), np.float64)
-    padded[: len(local_sample)] = local_sample
-    gathered = allgather_f64(padded)                      # [W, smax, F]
-    flat = np.concatenate([gathered[w, : int(sizes[w])]
-                           for w in range(gathered.shape[0])])
+    flat = _allgather_rows(local_sample, int(sizes.max()), sizes)
     cap = int(cfg.bin_construct_sample_cnt)
     if len(flat) > cap:
         idx = np.random.RandomState(cfg.data_random_seed).choice(

@@ -36,9 +36,10 @@ import numpy as np
 
 from ..config import Config
 from ..dataset import Dataset
-from .common import (check_scatter_divisible, check_tree_divergence,
-                     make_split_kw, pad_cols_to_ndev, padded_bin_count,
-                     resolve_hist_exchange, sentinel_bins_t,
+from ..sharded.mesh import (check_scatter_divisible, check_tree_divergence,
+                            make_mesh, mesh_axes, pad_cols_to_ndev,
+                            resolve_hist_exchange)
+from .common import (make_split_kw, padded_bin_count, sentinel_bins_t,
                      use_parent_hist_cache)
 from ..jaxutil import bag_mask_dev, pad_rows_dev, slice_rows_dev
 from ..ops.histogram import histogram_full_masked
@@ -516,7 +517,7 @@ class FusedTreeLearner:
         self.B = padded_bin_count(dataset.max_num_bin)
 
         if mesh is not None:
-            axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            axes = mesh_axes(mesh)
         else:
             axes = {}
         self.dd = int(axes.get("data", 1))
@@ -525,7 +526,7 @@ class FusedTreeLearner:
         # the global row axis is assembled per-process (MultiHostRows)
         self.mh = None
         if mesh is not None and jax.process_count() > 1:
-            from .common import MultiHostRows
+            from ..sharded.mesh import MultiHostRows
             self.mh = MultiHostRows(mesh, self.N)
             self.Np = self.mh.np_global
             self._local_np = self.mh.per_proc
@@ -633,7 +634,7 @@ class FusedTreeLearner:
             in_specs = (P(fa, da), P(da), P(da), P(da), P(fa), P(fa), P(fa))
             out_specs = (jax.tree_util.tree_map(lambda _: P(), TreeArrays(
                 *[0] * len(TreeArrays._fields))), P(da))
-            from .common import compat_shard_map
+            from ..sharded.mesh import compat_shard_map
             self._build = jax.jit(compat_shard_map(
                 fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                 check_vma=False))
@@ -744,36 +745,6 @@ class FusedTreeLearner:
         return tree, slice_rows_dev(leaf_id, n=self.N)
 
 
-def make_mesh(tree_learner: str, num_machines: int = 0
-              ) -> Optional[jax.sharding.Mesh]:
-    """Mesh for a distributed learner type.  `data` shards rows,
-    `feature` shards the split search (reference tree_learner types,
-    config.h:233; the topology/linker machinery of src/network is replaced
-    by the mesh itself)."""
-    devs = jax.devices()
-    if jax.process_count() > 1:
-        # num_machines counts HOSTS (reference config.h:246); the mesh
-        # always spans every device of the multi-process world
-        n = len(devs)
-    else:
-        n = num_machines if num_machines and num_machines > 1 else len(devs)
-        n = min(n, len(devs))
-    if n <= 1:
-        return None
-    devs = np.asarray(devs[:n])
-    if tree_learner in ("data", "voting"):
-        return jax.sharding.Mesh(devs.reshape(n, 1), ("data", "feature"))
-    if tree_learner == "feature":
-        return jax.sharding.Mesh(devs.reshape(1, n), ("data", "feature"))
-    # hybrid "data2d": balanced 2-D factorization
-    df = 1
-    for f in range(int(math.isqrt(n)), 0, -1):
-        if n % f == 0:
-            df = f
-            break
-    return jax.sharding.Mesh(devs.reshape(n // df, df), ("data", "feature"))
-
-
 def create_tree_learner(dataset: Dataset, config: Config):
     """Factory (reference tree_learner.cpp:9-33).
 
@@ -802,12 +773,18 @@ def create_tree_learner(dataset: Dataset, config: Config):
             warnings.warn(f"tree_learner={lt} requested but only one device "
                           "is visible; running single-device")
 
-    feature_sharded = (mesh is not None and dict(
-        zip(mesh.axis_names, mesh.devices.shape)).get("feature", 1) > 1)
+    feature_sharded = (mesh is not None
+                       and mesh_axes(mesh).get("feature", 1) > 1)
     if lt == "voting" and mesh is not None:
         # PV-Tree needs the per-split vote exchange of the fused builder
         return FusedTreeLearner(dataset, config, mesh)
-    if growth == "rounds" and not feature_sharded:
+    if growth == "rounds" and (not feature_sharded or lt == "data2d"):
+        # data2d + rounds runs the 2-D (data x feature) mesh inside the
+        # rounds builder itself: rows shard over both axes, histograms
+        # psum over data and reduce-scatter over feature
+        # (docs/Distributed-Data.md).  tree_learner=feature keeps the
+        # fused exact builder (its feature sharding splits the search
+        # over replicated rows, a different decomposition).
         from .rounds import RoundsTreeLearner
         return RoundsTreeLearner(dataset, config, mesh)
     if mesh is not None:

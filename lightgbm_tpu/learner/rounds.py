@@ -45,10 +45,11 @@ import numpy as np
 
 from ..config import Config
 from ..dataset import Dataset
-from .common import (check_scatter_divisible, check_tree_divergence,
-                     gather_capacity_tiers, gather_scratch_capacity,
-                     make_split_kw, pad_cols_to_ndev, padded_bin_count,
-                     resolve_hist_exchange, resolve_hist_rows,
+from ..sharded.mesh import (check_scatter_divisible, check_tree_divergence,
+                            mesh_axes, pad_cols_to_ndev,
+                            resolve_hist_exchange)
+from .common import (gather_capacity_tiers, gather_scratch_capacity,
+                     make_split_kw, padded_bin_count, resolve_hist_rows,
                      sentinel_bins_t, use_parent_hist_cache)
 from .fused import TreeArrays, tree_arrays_to_host
 from ..jaxutil import bag_mask_dev, pad_rows_dev, slice_rows_dev, \
@@ -126,6 +127,7 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
                       max_depth: int, min_data_in_leaf: int,
                       min_sum_hessian_in_leaf: float,
                       data_axis: Optional[str] = None,
+                      feature_axis: Optional[str] = None,
                       backend: str = "xla",
                       input_dtype: str = "float32",
                       max_rounds: int = 0,
@@ -133,6 +135,7 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
                       hist_rows: str = "masked",
                       hist_exchange: str = "psum",
                       num_devices: int = 1,
+                      num_feature_shards: int = 1,
                       leaves_per_batch: int = 0):
     """Grow one tree in batched rounds.  Shapes as learner/fused.build_tree.
     Returns (TreeArrays, leaf_id, stats) — stats is a [3] f32 vector:
@@ -172,6 +175,19 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
     (num_devices x less memory).  F must then divide evenly by
     num_devices (callers pad the store).
 
+    feature_axis adds the 2-D (data x feature) mesh topology
+    (docs/Distributed-Data.md): rows shard over BOTH axes (every device
+    holds all store columns of its row block); the exchange
+    reduce-scatters over the FEATURE axis first and then psums only
+    the resulting F/num_feature_shards slice over the DATA axis — the
+    axis meant to span hosts moves the slice, not the full store —
+    leaving each device its column slice fully reduced across all
+    num_devices * num_feature_shards row shards.  Split records combine over the
+    feature axis; leaf totals, control flow, and the grown tree stay
+    bitwise replicated across the whole mesh, so 2-D trees are
+    IDENTICAL to the 1-D psum and psum_scatter trees (the MULTICHIP
+    dryrun gate).  F must divide evenly by num_feature_shards.
+
     `bins` holds STORE columns (bundled under EFB); num_bins/is_cat/fmask
     are per-ORIGINAL-feature.  `ftbl` is the [5, F] feature→column table
     (identity when unbundled) and `unb` the optional unbundle-gather
@@ -192,8 +208,15 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
     K = leaves_per_batch or LEAVES_PER_BATCH
     n_chunks = (L + K - 1) // K
     gathered = hist_rows == "gathered"
-    hx = hist_exchange == "psum_scatter" and data_axis is not None
-    nd = num_devices if data_axis is not None else 1
+    # rows shard over every mesh axis present; under psum_scatter the
+    # store-column axis scatters over ONE of them — the feature axis on
+    # a 2-D (data x feature) mesh, else the data axis (1-D)
+    row_axes = tuple(a for a in (data_axis, feature_axis)
+                     if a is not None) or None
+    sc_axis = feature_axis if feature_axis is not None else data_axis
+    hx = hist_exchange == "psum_scatter" and sc_axis is not None
+    nd = (num_feature_shards if feature_axis is not None
+          else (num_devices if data_axis is not None else 1))
     if hx:
         # trace-time guard with a named ValueError (the learner pads the
         # store, so only direct build_tree_rounds callers can trip it)
@@ -201,23 +224,40 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
     Fs = F // nd if hx else F
 
     def exchange(h):
-        """Reduce a LOCAL histogram [..., F, 3, B] across the data axis:
-        full psum, or reduce-scatter keeping this shard's [Fs, 3, B]
-        store-column slice."""
-        if data_axis is None:
+        """Reduce a LOCAL histogram [..., F, 3, B] across the row axes:
+        full psum, or reduce(-scatter) keeping this shard's [Fs, 3, B]
+        store-column slice.  On the 2-D mesh the reduction decomposes
+        as reduce-scatter over the FEATURE axis first (dropping to the
+        F/df slice while still inside the intra-host axis) and then a
+        psum of only that slice over the DATA axis — the axis that
+        spans hosts moves F/df columns, not F (one-step psum_scatter
+        on a 1-D mesh)."""
+        if row_axes is None:
             return h
         if hx:
-            return jax.lax.psum_scatter(h, data_axis,
-                                        scatter_dimension=h.ndim - 3,
-                                        tiled=True)
-        return jax.lax.psum(h, data_axis)
+            h = jax.lax.psum_scatter(h, sc_axis,
+                                     scatter_dimension=h.ndim - 3,
+                                     tiled=True)
+            if data_axis is not None and feature_axis is not None:
+                h = jax.lax.psum(h, data_axis)
+            return h
+        return jax.lax.psum(h, row_axes)
+
+    # per-device reduced payload per collective leg: the scatter leg
+    # keeps the F/nd slice; the 2-D mesh adds the data-axis psum of
+    # that same slice as a second leg
+    hx_legs = 2 if (hx and data_axis is not None
+                    and feature_axis is not None) else 1
 
     def _exchange_bytes(k2: int) -> float:
         """Per-device reduced-histogram payload of one k2-leaf pass:
-        the full tensor under psum, the F/nd slice under psum_scatter."""
-        if data_axis is None:
+        the full tensor under psum, the F/nd slice (times the collective
+        legs of the 2-D decomposition) under psum_scatter."""
+        if row_axes is None:
             return 0.0
-        return 4.0 * k2 * (Fs if hx else F) * 3 * B
+        if hx:
+            return 4.0 * k2 * Fs * 3 * B * hx_legs
+        return 4.0 * k2 * F * 3 * B
 
     def _records_bytes(k2: int) -> float:
         """Per-device payload of the best-split-record allgather (only
@@ -229,7 +269,7 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
         # ceil(N/2); direct large-child passes (bounded-memory mode) by N
         tiers_all = gather_capacity_tiers(Nloc)
         tiers_small = gather_capacity_tiers(gather_scratch_capacity(Nloc))
-        if data_axis is not None:
+        if row_axes is not None:
             # the ceil(N/2) smaller-child bound is GLOBAL: smaller/larger
             # is decided on global counts, so one shard's local share of
             # the globally-smaller children can reach ALL of its rows.
@@ -268,7 +308,7 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
         smallest feature id (ops/split.combine_sharded_records — the
         full search's flat-argmax tie-break, shard-order independent)."""
         if hx:
-            off = jax.lax.axis_index(data_axis) * Fs
+            off = jax.lax.axis_index(sc_axis) * Fs
             if unb is None:
                 nb_s = jax.lax.dynamic_slice_in_dim(num_bins, off, Fs)
                 ic_s = jax.lax.dynamic_slice_in_dim(is_cat, off, Fs)
@@ -295,7 +335,7 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
 
         recs = jax.vmap(one)(hists, sums)
         if hx:
-            recs = combine_sharded_records(recs, data_axis)
+            recs = combine_sharded_records(recs, sc_axis)
         return recs
 
     # ---- root ---------------------------------------------------------------
@@ -314,11 +354,11 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
         # every shard
         ls = jnp.stack([jnp.sum(h0[0, 0, 0, :]), jnp.sum(h0[0, 0, 1, :]),
                         jnp.sum(h0[0, 0, 2, :])])
-        root_sums = jax.lax.psum(ls, data_axis)
+        root_sums = jax.lax.psum(ls, row_axes)
         cnt = root_sums[2]
         hist0 = exchange(h0[0])                         # [Fs, 3, B]
     else:
-        hist0 = _psum(h0[0], data_axis)                 # [F, 3, B]
+        hist0 = _psum(h0[0], row_axes)                  # [F, 3, B]
         sum_g = jnp.sum(hist0[0, 0, :])
         sum_h = jnp.sum(hist0[0, 1, :])
         cnt = jnp.sum(hist0[0, 2, :])
@@ -660,7 +700,7 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
     # rows are summed across shards (global traffic); the byte counters
     # stay per-device (passes are uniform, so every shard agrees)
     stv = st[-2]
-    return st[-1], st[1], stv.at[0].set(_psum(stv[0], data_axis))
+    return st[-1], st[1], stv.at[0].set(_psum(stv[0], row_axes))
 
 
 class RoundsTreeLearner:
@@ -676,18 +716,25 @@ class RoundsTreeLearner:
         self.F = dataset.num_features
         self.B = padded_bin_count(dataset.max_num_bin)
         if mesh is not None:
-            axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            axes = mesh_axes(mesh)
         else:
             axes = {}
         self.dd = int(axes.get("data", 1))
+        # 2-D (data x feature) mesh: rows shard over BOTH axes and the
+        # psum_scatter exchange scatters store columns over the feature
+        # axis (docs/Distributed-Data.md); nsh is the total row-shard
+        # count, nd_sc the scatter world the column padding must tile
+        self.df = int(axes.get("feature", 1))
+        nsh = self.dd * self.df
+        self._nd_sc = self.df if self.df > 1 else self.dd
         self.mh = None
         if mesh is not None and jax.process_count() > 1:
-            from .common import MultiHostRows
+            from ..sharded.mesh import MultiHostRows
             self.mh = MultiHostRows(mesh, self.N)
             self.Np = self.mh.np_global
             self._local_np = self.mh.per_proc
         else:
-            self.Np = int(self.dd * math.ceil(self.N / self.dd))
+            self.Np = int(nsh * math.ceil(self.N / max(nsh, 1)))
             self._local_np = self.Np
 
         backend = ("pallas" if jax.default_backend() == "tpu" else "xla")
@@ -721,11 +768,11 @@ class RoundsTreeLearner:
         # keeps the int8 kernel's 32-sublane grouping.
         K_pass = min(LEAVES_PER_BATCH, int(config.num_leaves))
         self.hist_exchange = resolve_hist_exchange(
-            config, ndev=self.dd,
+            config, ndev=nsh,
             payload_bytes=4.0 * K_pass * self.Fpad * 3 * self.B)
-        if self.hist_exchange == "psum_scatter" and self.dd > 1:
+        if self.hist_exchange == "psum_scatter" and nsh > 1:
             self.Fpad = pad_cols_to_ndev(
-                self.Fpad, self.dd,
+                self.Fpad, self._nd_sc,
                 align=32 if bins_np.dtype == np.int8 else 1)
         # pad value must be an in-range bin; padded rows/features carry
         # zero mask so their bin never matters
@@ -766,8 +813,8 @@ class RoundsTreeLearner:
         # histogram-memory bound (reference HistogramPool analog); the
         # column count is this shard's local share of the STORE — under
         # psum_scatter each device caches only its F/ndev column slice
-        cache_cols = (self.Fpad // self.dd
-                      if self.hist_exchange == "psum_scatter" and self.dd > 1
+        cache_cols = (self.Fpad // self._nd_sc
+                      if self.hist_exchange == "psum_scatter" and nsh > 1
                       else self.Fpad)
         self.cache_parent_hist = use_parent_hist_cache(cfg, cache_cols,
                                                        self.B)
@@ -778,7 +825,7 @@ class RoundsTreeLearner:
         self.hist_rows = resolve_hist_rows(
             cfg, backend=backend,
             num_columns=self.Fpad,
-            np_rows=max(1, self.Np // max(self.dd, 1)),
+            np_rows=max(1, self.Np // max(nsh, 1)),
             bins_itemsize=int(bins_np.dtype.itemsize))
         kw = dict(num_leaves=cfg.num_leaves, num_bins_padded=self.B,
                   max_num_bin=int(dataset.max_num_bin),
@@ -790,6 +837,7 @@ class RoundsTreeLearner:
                   hist_rows=self.hist_rows,
                   hist_exchange=self.hist_exchange,
                   num_devices=self.dd,
+                  num_feature_shards=self.df,
                   ftbl=ftbl, unb=unb,
                   input_dtype=getattr(cfg, "histogram_dtype", "float32"))
         if mesh is None:
@@ -797,13 +845,17 @@ class RoundsTreeLearner:
             self.bins_dev = jnp.asarray(bins_np)
         else:
             from jax.sharding import PartitionSpec as P, NamedSharding
-            fn = functools.partial(build_tree_rounds, **kw,
-                                   data_axis="data" if self.dd > 1 else None)
-            da = "data" if self.dd > 1 else None
+            from ..sharded.mesh import compat_shard_map, row_shard_axes
+            fn = functools.partial(
+                build_tree_rounds, **kw,
+                data_axis="data" if self.dd > 1 else None,
+                feature_axis="feature" if self.df > 1 else None)
+            # rows shard over every mesh axis present (the 2-D mesh
+            # splits the row axis dd*df ways; store columns replicate)
+            da = row_shard_axes(self.dd, self.df)
             in_specs = (P(None, da), P(da), P(da), P(da), P(), P(), P())
             out_specs = (jax.tree_util.tree_map(lambda _: P(), TreeArrays(
                 *[0] * len(TreeArrays._fields))), P(da), P())
-            from .common import compat_shard_map
             self._build = jax.jit(compat_shard_map(
                 fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                 check_vma=False))
@@ -829,7 +881,7 @@ class RoundsTreeLearner:
             return ov == "1"
         # bins shard along the data axis: the pressure that matters is
         # the PER-DEVICE share of the int32 STORE layout
-        int32_bytes = 4.0 * self.Cstore * self.Np / max(self.dd, 1)
+        int32_bytes = 4.0 * self.Cstore * self.Np / max(self.dd * self.df, 1)
         try:
             stats = jax.local_devices()[0].memory_stats()
             limit = float(stats.get("bytes_limit", 0)) or 16e9

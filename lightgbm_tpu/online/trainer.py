@@ -337,44 +337,37 @@ class OnlineTrainer:
         if self._buffered_rows < self.trigger:
             return
         # first full window: freeze the bin mappers + bundle plan here;
-        # every later chunk bins against them (no re-quantization)
-        Xa = np.concatenate([b[0] for b in self._buffer])
-        ya = np.concatenate([b[1] for b in self._buffer])
-        wa = (np.concatenate([
-            np.ones(len(b[0]), np.float32) if b[2] is None else b[2]
-            for b in self._buffer])
-            if any(b[2] is not None for b in self._buffer) else None)
-        base = RawDataset(Xa, ya, self.cfg)
-        self._window = RawDataset.streaming_from(
-            base, self.cfg, capacity=max(self.trigger, len(Xa)))
-        # `base` already binned these exact rows against the mappers
-        # the window just froze — adopt its store instead of re-binning
-        # (append_rows produces bitwise-identical bins:
-        # tests/test_online.py::test_streaming_append_matches_batch_binning)
-        win = self._window
-        win.bins[:, : len(Xa)] = base.bins
-        win.num_data = len(Xa)
-        win.bundle_conflict_rows = base.bundle_conflict_rows
-        win.metadata.label = ya.astype(np.float32)
-        if wa is not None:
-            win.metadata.weights = wa.astype(np.float32)
-        win._device_bins = None
+        # every later chunk bins against them (no re-quantization).
+        # Construction routes through Dataset.from_stream — the shared
+        # out-of-core ingestion path (sharded/ingest.py): a sketch pass
+        # over the buffered chunks derives the mappers (exact at window
+        # sizes, bitwise what batch construction would freeze), then
+        # each chunk bins straight into the capacity-tiered window —
+        # the buffer is never concatenated into one monolithic raw
+        # matrix.
+        rows = self._buffered_rows
+        self._window = RawDataset.from_stream(
+            list(self._buffer), self.cfg,
+            capacity=max(self.trigger, rows))
         if self.mode == "refit":
-            self._leaf_chunks.append(
-                self.booster._gbdt.predict_leaf_index(Xa))
+            # exact raw-feature routing per buffered chunk, while the
+            # raw values are still in hand
+            for bx, _by, _bw in self._buffer:
+                self._leaf_chunks.append(
+                    self.booster._gbdt.predict_leaf_index(bx))
         self._buffer = []
         self._buffered_rows = 0
         # the frozen mappers outlive this process: a restarted daemon
         # restores them from the sidecar instead of re-freezing from
         # whatever window happens to be pending at restart time
         try:
-            self._save_refbin(base)
+            self._save_refbin(self._window.compacted())
         except OSError as e:
             log.warning(f"online: could not persist frozen mappers to "
                         f"{self.refbin_path} ({type(e).__name__}: {e}); "
                         "a restart would re-freeze from its first window")
         log.info(f"online: froze bin mappers from the first "
-                 f"{len(Xa)}-row window "
+                 f"{rows}-row window "
                  f"({self._window.num_features} used features, "
                  f"store capacity {self._window.row_capacity})")
 

@@ -1,0 +1,17 @@
+"""Pod-scale data plane (ROADMAP #1): the pieces that let training data
+be found, binned, and sharded without any single host ever holding the
+whole dataset.
+
+- `sketch`  — mergeable quantile sketches: per-host / per-chunk weighted
+  summaries that merge with one small collective, replacing the
+  full-sample allgather of distributed bin finding.
+- `ingest`  — out-of-core streamed dataset construction:
+  `Dataset.from_stream` runs a sketch pass then bins chunk-by-chunk
+  into the capacity-tiered store, so peak host memory scales with
+  `stream_chunk_rows`, not with the dataset length.
+- `mesh`    — the sharded-primitive layer: mesh/axis resolution,
+  shard_map compatibility, column padding and scatter-divisibility
+  guards, psum/psum_scatter selection, and the multi-host row-block
+  assembly shared by every mesh learner (previously duplicated across
+  learner/common.py, rounds.py and fused.py).
+"""

@@ -98,8 +98,42 @@ def main():
         "per-device comms volume drops ~`ndev`x, and split-search work "
         "too on unbundled stores. `auto` = psum_scatter when the "
         "per-pass payload "
-        "reaches ~1 MiB (`LGBT_HIST_EXCHANGE_MIN_BYTES` override), "
-        "psum below it. See docs/Readme.md \"Histogram exchange\".",
+        "reaches the `hist_exchange_min_bytes` crossover, psum below "
+        "it. On a 2-D `data2d` mesh with the rounds learner, the "
+        "exchange decomposes into a psum over the data axis plus a "
+        "reduce-scatter over the feature axis "
+        "(docs/Distributed-Data.md). See docs/Readme.md "
+        "\"Histogram exchange\".",
+        "- `hist_exchange_min_bytes` (default `-1`, aliases "
+        "`hist_exchange_threshold`, `histogram_exchange_min_bytes`): "
+        "the `hist_exchange=auto` crossover in bytes — below it the "
+        "full psum is cheaper than reduce-scatter plus the per-leaf "
+        "record allgather.  `-1` keeps the built-in 1 MiB default (or "
+        "the `LGBT_HIST_EXCHANGE_MIN_BYTES` env override for ad-hoc "
+        "on-chip tuning); `>= 0` pins it.  The measured crossover on "
+        "chip lands in `hist_exchange_ab_measured.json`.",
+        "- `bin_find` (default `auto`, aliases `bin_finding`, "
+        "`distributed_bin_find`): how distributed / out-of-core bin "
+        "boundaries are found.  `allgather` derives mappers from the "
+        "process-allgathered global sample (the validated exact path); "
+        "`sketch` merges per-host mergeable quantile summaries in one "
+        "O(F/eps) collective so NO host ever materializes the global "
+        "sample; `auto` stays exact while the combined sample fits "
+        "`bin_construct_sample_cnt` and switches to sketches beyond.  "
+        "See docs/Distributed-Data.md.",
+        "- `sketch_eps` (default `0.001`, aliases "
+        "`quantile_sketch_eps`, `sketch_epsilon`): rank-error knob of "
+        "the quantile sketch — each summary keeps O(1/eps) weighted "
+        "entries per feature, and derived boundaries carry the "
+        "documented eps rank guarantee.  Tight enough that every "
+        "distinct value fits, the sketch is EXACT (bitwise the "
+        "allgather boundaries).",
+        "- `stream_chunk_rows` (default `262144`, aliases "
+        "`stream_chunk_size`, `ingest_chunk_rows`): row-chunk size of "
+        "streamed construction (`Dataset.from_stream` and the "
+        "two-round file loader) — peak host memory of ingestion "
+        "scales with this, not the dataset length "
+        "(bench_ingest_measured.json).",
         "",
         "- `predict_kernel` (default `auto`, aliases "
         "`prediction_kernel`, `predict_engine`): device ensemble-"

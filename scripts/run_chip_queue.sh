@@ -65,6 +65,11 @@ stage bench_online env BENCH_SANITIZE=1 BENCH_ONLINE_OUT=bench_online_measured.j
 # gated on bitwise answers, recovery, and 0 request-path compiles /
 # 0 retraces / 0 implicit transfers — refreshes the committed artifact
 stage bench_chaos env BENCH_SANITIZE=1 BENCH_CHAOS_OUT=bench_chaos_measured.json python scripts/bench_chaos.py || exit 1
+# streamed-vs-monolithic ingestion: peak RSS bounded by stream_chunk_rows
+# (not N), streamed store bitwise == batch within the sample budget,
+# streamed-store training sanitized at 0 retraces / 0 implicit transfers
+# — refreshes the committed artifact
+stage bench_ingest env BENCH_SANITIZE=1 BENCH_INGEST_OUT=bench_ingest_measured.json python scripts/bench_ingest.py || exit 1
 stage bench_narrow_off env LGBT_NARROW_ONEHOT=0 BENCH_ITERS=12 python bench.py || exit 1
 stage bench_part_off   env LGBT_FUSED_PARTITION=0 BENCH_ITERS=12 python bench.py || exit 1
 # 2. the 63-bin variant (VERDICT #2: reference accelerator sweet spot)

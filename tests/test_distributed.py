@@ -179,6 +179,99 @@ def test_two_process_collective_plumbing(tmp_path):
     assert any("COLLECTIVE_OK 1" in o for o in outs)
 
 
+_SKETCH_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, {root!r})
+    import numpy as np
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.distributed import (find_bin_mappers_distributed,
+                                          init_distributed)
+    assert init_distributed(num_machines=2, local_listen_port={port})
+    rank = jax.process_index()
+
+    rng = np.random.RandomState(23)
+    full = rng.randn(4000, 4)
+    full[:, 2] = np.where(rng.rand(4000) < 0.7, 0.0, full[:, 2])
+    local = full[rank * 2000:(rank + 1) * 2000]
+
+    # 1. sketch path at tight eps (summaries stay exact): mappers must
+    #    be BITWISE the single-process direct mappers over the full
+    #    sample — and identical on every rank by construction
+    cfg = Config(bin_find="sketch", sketch_eps=1e-5)
+    mappers, plan_sample = find_bin_mappers_distributed(
+        local, cfg, return_sample=True)
+    from lightgbm_tpu.binning import find_bin_mappers
+    want = find_bin_mappers(full, cfg.max_bin, cfg.min_data_in_bin,
+                            cfg.min_data_in_leaf, sample_cnt=len(full),
+                            seed=cfg.data_random_seed)
+    for g, w in zip(mappers, want):
+        assert np.array_equal(np.asarray(g.bin_upper_bound),
+                              np.asarray(w.bin_upper_bound)), "sketch!=exact"
+        assert g.num_bin == w.num_bin and g.is_trivial == w.is_trivial
+
+    # 2. the sketch path never gathers the global sample: the returned
+    #    plan sample is the BOUNDED bundle-planning sample, identical
+    #    on every rank
+    from lightgbm_tpu.dataset import BUNDLE_PLAN_SAMPLE_CNT
+    assert len(plan_sample) <= BUNDLE_PLAN_SAMPLE_CNT
+    from jax.experimental import multihost_utils
+    import hashlib
+    h = np.frombuffer(hashlib.sha1(
+        np.ascontiguousarray(plan_sample).tobytes()).digest(), np.uint8)
+    all_h = multihost_utils.process_allgather(h.copy())
+    assert (all_h[0] == all_h[1]).all(), "plan sample differs across ranks"
+
+    # 3. loose eps: compacted summaries — mappers still IDENTICAL on
+    #    every rank (deterministic merge of the identical stack) and
+    #    bin counts in the exact regime's ballpark
+    cfg2 = Config(bin_find="sketch", sketch_eps=0.05)
+    m2 = find_bin_mappers_distributed(local, cfg2)
+    infos = "|".join(m.feature_info() for m in m2).encode()
+    h2 = np.frombuffer(hashlib.sha1(infos).digest(), np.uint8)
+    all_h2 = multihost_utils.process_allgather(h2.copy())
+    assert (all_h2[0] == all_h2[1]).all(), "loose-eps mappers differ"
+    for g, w in zip(m2, want):
+        assert g.is_trivial == w.is_trivial
+        assert g.num_bin >= w.num_bin // 2
+    print("SKETCH_OK", rank)
+""")
+
+
+def test_two_process_sketch_mapper_parity(tmp_path):
+    """bin_find=sketch across a 2-process world: tight-eps mappers are
+    bitwise the single-process exact mappers on every rank, the bundle
+    plan sample stays bounded (no global sample), and loose-eps merges
+    are rank-deterministic.  Self-skips on jax builds whose CPU backend
+    cannot run multiprocess computations (the same limitation as the
+    other two-process tests)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "sketch_worker.py"
+    script.write_text(_SKETCH_WORKER.format(root=root, port=12447))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = []
+    for rank in (0, 1):
+        e = dict(env, LIGHTGBM_TPU_MACHINE_RANK=str(rank))
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=e,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        outs.append(out)
+    if any("Multiprocess computations aren't implemented" in o
+           for o in outs):
+        pytest.skip("this jax build has no multiprocess CPU backend")
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-2000:]
+    assert any("SKETCH_OK 0" in o for o in outs)
+    assert any("SKETCH_OK 1" in o for o in outs)
+
+
 _WORKER = textwrap.dedent("""
     import os, sys
     os.environ["JAX_PLATFORMS"] = "cpu"
