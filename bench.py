@@ -91,6 +91,13 @@ HIST_EXCHANGE = os.environ.get("BENCH_HIST_EXCHANGE", "")
 # importing the package at module level would initialize jax before the
 # backend-liveness probe below.
 SANITIZE = os.environ.get("BENCH_SANITIZE", "0") not in ("0", "", "false")
+# BENCH_TRACE=<logdir>: wrap the timed window in profiling.device_trace
+# (jax.profiler → xprof/TensorBoard artifacts in <logdir>) and record
+# the artifact dir in the JSON line, so a chip-queue window captures
+# device traces for free; with telemetry enabled the same window also
+# emits a `profiling.device_trace` host span carrying the logdir, which
+# is how scripts/trace_view.py lines the two up.
+TRACE_DIR = os.environ.get("BENCH_TRACE", "")
 
 
 def _feature_fingerprint(X) -> str:
@@ -261,17 +268,21 @@ def main():
     hx_t0 = profiling.counter_value(profiling.HIST_EXCHANGE_BYTES)
     sr_t0 = profiling.counter_value(profiling.SPLIT_RECORDS_BYTES)
     san = None
+    import contextlib
+    trace_ctx = (profiling.device_trace(TRACE_DIR) if TRACE_DIR
+                 else contextlib.nullcontext())
     t0 = time.perf_counter()
-    if SANITIZE:
-        from lightgbm_tpu.diagnostics.sanitize import HotPathSanitizer
-        san = HotPathSanitizer(warmup=1, label=f"train/{WORKLOAD}")
-        with san:
+    with trace_ctx:
+        if SANITIZE:
+            from lightgbm_tpu.diagnostics.sanitize import HotPathSanitizer
+            san = HotPathSanitizer(warmup=1, label=f"train/{WORKLOAD}")
+            with san:
+                for _ in range(ITERS):
+                    with san.step():
+                        bst.update()
+        else:
             for _ in range(ITERS):
-                with san.step():
-                    bst.update()
-    else:
-        for _ in range(ITERS):
-            bst.update()
+                bst.update()
     # value fetch: bounds the in-flight pipelined iteration (update()
     # syncs only the PREVIOUS tree; block_until_ready can return early
     # on the tunneled remote-TPU platform)
@@ -386,6 +397,8 @@ def main():
     }
     if san is not None:
         out["sanitize"] = san.report()
+    if TRACE_DIR:
+        out["device_trace_dir"] = TRACE_DIR
     if note:
         out["note"] = note
     # full 500-iteration accuracy evidence (scripts/run_northstar.py)

@@ -56,18 +56,35 @@ class Application:
         check_param_conflict(self.config)
 
     def run(self) -> None:
-        if self.config.task == "train":
-            self._train()
-        elif self.config.task in ("predict", "prediction", "test"):
-            self._predict()
-        elif self.config.task in ("serve", "serving"):
-            self._serve()
-        elif self.config.task in ("online", "online_train"):
-            self._online()
-        elif self.config.task in ("refit", "refit_tree"):
-            self._refit()
-        else:
-            raise LightGBMError(f"unknown task: {self.config.task}")
+        cfg = self.config
+        from . import telemetry
+        # the task IS the process role: spans from a trainer, a daemon
+        # and a serving fleet sharing one telemetry_path stay
+        # distinguishable (and land in separate chrome-trace pid lanes)
+        telemetry.set_process(cfg.task)
+        # standalone Prometheus /metrics for roles without their own
+        # HTTP server; task=serve mounts the same payload on its own
+        # endpoint instead (serving/server.py)
+        metrics_srv = None
+        if cfg.metrics_port and cfg.task not in ("serve", "serving"):
+            metrics_srv = telemetry.start_metrics_server(
+                cfg.metrics_port, host=cfg.serve_host)
+        try:
+            if cfg.task == "train":
+                self._train()
+            elif cfg.task in ("predict", "prediction", "test"):
+                self._predict()
+            elif cfg.task in ("serve", "serving"):
+                self._serve()
+            elif cfg.task in ("online", "online_train"):
+                self._online()
+            elif cfg.task in ("refit", "refit_tree"):
+                self._refit()
+            else:
+                raise LightGBMError(f"unknown task: {cfg.task}")
+        finally:
+            if metrics_srv is not None:
+                metrics_srv.close()
 
     # ------------------------------------------------------------------
     def _train(self) -> None:

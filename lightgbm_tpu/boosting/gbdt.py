@@ -22,6 +22,7 @@ import hashlib
 import io
 import json
 import os
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -56,6 +57,10 @@ _FINGERPRINT_EXCLUDE = frozenset({
     "replica_failure_threshold",
     "refit_decay_rate", "refit_min_rows", "online_trigger_rows",
     "online_mode",
+    # observability knobs: where spans/metrics go never changes what a
+    # run trains — pointing telemetry elsewhere between crash and
+    # resume must not discard the checkpoint
+    "telemetry_path", "metrics_port",
 })
 
 
@@ -376,18 +381,74 @@ class GBDT:
         self.iter_ += 1
         return False
 
+    # -- per-iteration telemetry (docs/Observability.md) ---------------
+
+    def _telemetry_iter_begin(self) -> None:
+        """Snapshot host-side accumulators so the end-of-iteration
+        record can report deltas.  Costs one cached check when
+        telemetry is off; never touches the device either way — the
+        pipelined path's zero-sync contract holds with telemetry on.
+        Deliberate: iterations that ABORT (no splittable leaves — the
+        trees are popped and iter_ rolled back) emit no record; only
+        completed iterations exist in the stream, matching the model
+        they describe."""
+        from .. import telemetry
+        if not telemetry.enabled():
+            self._telem_t0 = None
+            return
+        from .. import profiling
+        self._telem_t0 = time.perf_counter()
+        self._telem_phases = profiling.timings()
+        self._telem_ctrs = profiling.counters_nosync("tree/")
+
+    def _telemetry_iter_end(self) -> None:
+        t0 = getattr(self, "_telem_t0", None)
+        if t0 is None:
+            return
+        from .. import profiling, telemetry
+        dt = time.perf_counter() - t0
+        phases = profiling.timings()
+        ctrs = profiling.counters_nosync("tree/")
+        ph = {}
+        for k, v in phases.items():
+            d = v - self._telem_phases.get(k, 0.0)
+            if d > 1e-9:
+                ph[k] = round(d, 6)
+        # host-visible deltas only: count_deferred device totals fold
+        # in at the next drain (a /metrics scrape or bench read), so on
+        # the pipelined path these lag rather than force a sync
+        deltas = {k.rsplit("/", 1)[-1]: round(v - self._telem_ctrs.get(k,
+                                                                       0.0),
+                                              1)
+                  for k, v in ctrs.items()}
+        telemetry.event("train.iteration", iteration=self.iter_,
+                        trees=len(self.models), rows=self.num_data,
+                        seconds=round(dt, 6), phases=ph,
+                        counters=deltas)
+
+    def _telemetry_eval(self, out: List) -> None:
+        """Eval results ride the span stream too — emitted only where
+        the caller already materialized them (ONE batched device_get),
+        so telemetry never adds a sync of its own."""
+        from .. import telemetry
+        if out and telemetry.enabled():
+            telemetry.event("train.eval", iteration=self.iter_,
+                            results=[[s, n, v] for s, n, v, _ in out])
+
     def train_one_iter(self, gradient: Optional[jax.Array] = None,
                        hessian: Optional[jax.Array] = None,
                        is_eval: bool = False) -> bool:
         """One boosting iteration.  Returns True when training should stop
         (early stopping or no splittable leaves)."""
         from .. import profiling
+        self._telemetry_iter_begin()
         if gradient is None and hessian is None and self._can_pipeline():
             if self._train_one_iter_pipelined():
                 return True
-            if is_eval:
-                return self.eval_and_check_early_stopping()
-            return False
+            stop = (self.eval_and_check_early_stopping() if is_eval
+                    else False)
+            self._telemetry_iter_end()
+            return stop
         self._flush_pending()
         self._boost_from_average()
         if gradient is None or hessian is None:
@@ -439,9 +500,9 @@ class GBDT:
                 self.models.pop()
             return True
         self.iter_ += 1
-        if is_eval:
-            return self.eval_and_check_early_stopping()
-        return False
+        stop = self.eval_and_check_early_stopping() if is_eval else False
+        self._telemetry_iter_end()
+        return stop
 
     def rollback_one_iter(self) -> None:
         self._flush_pending()
@@ -494,6 +555,7 @@ class GBDT:
             self._eval_one_set("training", self.train_score,
                                self.train_metrics, out)
             out = self._materialize_evals(out)
+        self._telemetry_eval(out)
         return out
 
     def eval_valid(self) -> List[Tuple[str, str, float, bool]]:
@@ -503,6 +565,7 @@ class GBDT:
             for name, _, su, ms in self.valid_sets:
                 self._eval_one_set(name, su, ms, out)
             out = self._materialize_evals(out)
+        self._telemetry_eval(out)
         return out
 
     def eval_and_check_early_stopping(self, results=None) -> bool:
@@ -869,17 +932,20 @@ class GBDT:
         leaves the PREVIOUS checkpoint intact, never a torn one.
         ``extra`` rides along in the state dict (the CLI records a
         ``finished`` marker so reruns of a completed command no-op)."""
-        from .. import log
+        from .. import log, telemetry
         from ..diagnostics import faults
         state = self.training_state()
         if extra:
             state.update(extra)
-        payload = json.dumps(state)
-        faults.torn_write("train.checkpoint", path, payload)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            f.write(payload)
-        os.replace(tmp, path)
+        with telemetry.span("train.checkpoint", path=path,
+                            iteration=self.iter_,
+                            trees=len(self.models)):
+            payload = json.dumps(state)
+            faults.torn_write("train.checkpoint", path, payload)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(payload)
+            os.replace(tmp, path)
         log.debug(f"checkpoint saved to {path} (iteration {self.iter_}, "
                   f"{len(self.models)} trees)")
         faults.check("train.after_checkpoint")
@@ -917,10 +983,15 @@ class GBDT:
         fresh training scores, restore counters/RNG.  Returns the
         iteration to continue from.  Valid sets added AFTER this call
         replay the restored model automatically (add_valid does)."""
-        self.load_model_from_string(state["model"])
-        self._replay_kernel = "walk"     # order-exact replay (see __init__)
-        self.reset_training_data(train_set, objective)
-        self.restore_training_state(state)
+        from .. import telemetry
+        with telemetry.span(
+                "train.resume",
+                checkpoint_iteration=int(state.get("iteration", 0))) as sp:
+            self.load_model_from_string(state["model"])
+            self._replay_kernel = "walk"  # order-exact replay (__init__)
+            self.reset_training_data(train_set, objective)
+            self.restore_training_state(state)
+            sp.set(trees=len(self.models))
         return self.iter_
 
 

@@ -154,6 +154,12 @@ PARAM_ALIASES: Dict[str, str] = {
     "sketch_epsilon": "sketch_eps",
     "stream_chunk_size": "stream_chunk_rows",
     "ingest_chunk_rows": "stream_chunk_rows",
+    # observability (docs/Observability.md, lightgbm_tpu/telemetry.py)
+    "telemetry": "telemetry_path",
+    "trace_path": "telemetry_path",
+    "span_path": "telemetry_path",
+    "prometheus_port": "metrics_port",
+    "telemetry_port": "metrics_port",
 }
 
 # objective name aliases (reference config.cpp GetObjectiveType handling)
@@ -433,6 +439,21 @@ class Config:
     # boosting (reset_training_data replay).
     online_mode: str = "refit"
 
+    # -- observability (lightgbm_tpu/telemetry.py, docs/Observability.md)
+    # structured span tracing: when set, every process role appends
+    # JSONL span/event records (trace-id/span-id/parent-id, monotonic
+    # durations) to this path — the serve→train→serve loop becomes
+    # reconstructable from trace ids alone, and
+    # `scripts/trace_view.py` converts the file to chrome://tracing
+    # JSON.  Empty = tracing off (the hot paths pay one cached check).
+    telemetry_path: str = ""
+    # standalone Prometheus /metrics listener for process roles without
+    # their own HTTP server (trainer, online daemon, batch predict):
+    # the profiling counters/reservoirs + process/device gauges in text
+    # exposition format.  0 = off.  task=serve always exposes the same
+    # payload at its own /metrics endpoint instead.
+    metrics_port: int = 0
+
     # fields that are parsed but unused on TPU (accepted for compat)
     config_file: str = ""
     output_freq: int = 1
@@ -515,6 +536,12 @@ def config_from_params(params: Dict[str, Any], **overrides) -> Config:
     # (reference: Log verbosity set once from config, log.h:38)
     from . import log
     log.configure(cfg.verbose)
+    # span tracing enables at the first config that names a sink (and
+    # only enables — a later config without the key must not silently
+    # disable a running daemon's telemetry)
+    if cfg.telemetry_path:
+        from . import telemetry
+        telemetry.configure(cfg.telemetry_path)
     return cfg
 
 
@@ -584,6 +611,8 @@ def check_param_conflict(cfg: Config) -> None:
                          "use refit or continue")
     if not (0.0 <= cfg.max_conflict_rate < 1.0):
         raise ValueError("max_conflict_rate must be in [0, 1)")
+    if not (0 <= cfg.metrics_port <= 65535):
+        raise ValueError("metrics_port must be in [0, 65535] (0 = off)")
 
 
 def parse_config_file(path: str) -> Dict[str, str]:

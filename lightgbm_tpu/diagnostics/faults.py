@@ -159,7 +159,15 @@ def fire(site: str) -> bool:
         hit = seqs is None or seq in seqs
         if hit:
             _fired[site] = _fired.get(site, 0) + 1
-        return hit
+    if hit:
+        # a firing is an operator-relevant incident: it lands in the
+        # span stream (under the current trace when one is active) so a
+        # chaos run's timeline shows WHICH request/refresh met the
+        # injected failure.  Emitted outside the lock; import is local
+        # because this module must stay importable with zero deps.
+        from .. import telemetry
+        telemetry.event("fault.fired", site=site, seq=seq)
+    return hit
 
 
 def check(site: str) -> None:

@@ -27,9 +27,16 @@ import numpy as np
 
 
 def append_traffic(path: str, X: np.ndarray, y: np.ndarray,
-                   weight: Optional[np.ndarray] = None) -> int:
+                   weight: Optional[np.ndarray] = None,
+                   trace_ids=None) -> int:
     """Append labeled rows to a traffic log (the writer half — what a
-    serving-side label joiner produces); returns rows written."""
+    serving-side label joiner produces); returns rows written.
+
+    ``trace_ids`` (one per row, or one string for all rows; None
+    entries allowed) stamps each record with the serving-side trace id
+    of the /predict request that scored it — the hop that lets the
+    online daemon's publish sidecar name the originating requests
+    (docs/Observability.md propagation diagram)."""
     from ..diagnostics import faults
     X = np.asarray(X, np.float64)
     if X.ndim == 1:
@@ -37,12 +44,18 @@ def append_traffic(path: str, X: np.ndarray, y: np.ndarray,
     y = np.asarray(y, np.float64).reshape(-1)
     if len(y) != len(X):
         raise ValueError("label length mismatch")
+    if isinstance(trace_ids, str):
+        trace_ids = [trace_ids] * len(X)
+    if trace_ids is not None and len(trace_ids) != len(X):
+        raise ValueError("trace_ids length mismatch")
     with open(path, "a") as f:
         for i in range(len(X)):
             rec = {"features": [float(v) for v in X[i]],
                    "label": float(y[i])}
             if weight is not None:
                 rec["weight"] = float(np.asarray(weight).reshape(-1)[i])
+            if trace_ids is not None and trace_ids[i]:
+                rec["trace_id"] = str(trace_ids[i])
             line = json.dumps(rec) + "\n"
             # chaos seam: a writer dying mid-append leaves a torn tail —
             # exactly what the reader's complete-lines-only contract
@@ -77,6 +90,11 @@ class TrafficLog:
         # per-poll read cap: a daemon (re)started against a multi-GB
         # backlog must drain it in bounded slices, not one giant blob
         self._max_poll = int(max_poll_bytes)
+        # trace ids of the rows the LAST read_new() returned (aligned
+        # with its X; None where the record carried none) — the
+        # serve→train trace-propagation hop the online trainer folds
+        # into its window provenance
+        self.last_trace_ids: list = []
 
     def counters(self) -> dict:
         """Silent-data-loss evidence for /stats (docs/Robustness.md):
@@ -127,7 +145,7 @@ class TrafficLog:
             return None             # else: only a torn tail so far
         consumed = blob[: last_nl + 1]
         self.offset += len(consumed)
-        feats, labels, weights = [], [], []
+        feats, labels, weights, traces = [], [], [], []
         any_weight = False
         for line in consumed.decode("utf-8", errors="replace").splitlines():
             line = line.strip()
@@ -139,10 +157,12 @@ class TrafficLog:
                     row = [float(v) for v in item["features"]]
                     lab = float(item["label"])
                     w = item.get("weight")
+                    tr = item.get("trace_id")
                 else:               # [label, f0, f1, ...] shorthand
                     lab = float(item[0])
                     row = [float(v) for v in item[1:]]
                     w = None
+                    tr = None
             except (ValueError, TypeError, KeyError, IndexError):
                 self.bad_lines += 1
                 continue
@@ -154,9 +174,11 @@ class TrafficLog:
             feats.append(row)
             labels.append(lab)
             weights.append(1.0 if w is None else float(w))
+            traces.append(str(tr) if tr is not None else None)
             any_weight = any_weight or w is not None
         if not feats:
             return None
+        self.last_trace_ids = traces
         self.rows_read += len(feats)
         X = np.asarray(feats, np.float64)
         y = np.asarray(labels, np.float64)

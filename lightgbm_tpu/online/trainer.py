@@ -57,7 +57,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-from .. import log
+from .. import log, telemetry
 from ..config import Config, config_from_params
 from ..dataset import Dataset as RawDataset
 from ..diagnostics import faults
@@ -129,6 +129,13 @@ class OnlineTrainer:
         # store quantizes thresholds that fall inside its bins);
         # structures are frozen in refit mode, so routing never stales
         self._leaf_chunks: List[np.ndarray] = []
+        # serve→train trace propagation: trace ids stamped into the
+        # traffic log by the serving side accumulate per window (capped
+        # — provenance, not a ledger) and ride into the publish sidecar
+        # as `origin_trace_ids`, independent of whether THIS process
+        # has span tracing on
+        self._window_traces: set = set()
+        self._WINDOW_TRACES_CAP = 1024
         if reference is not None:
             self._window = RawDataset.streaming_from(
                 reference, cfg, capacity=self.trigger)
@@ -378,6 +385,18 @@ class OnlineTrainer:
         fires.  Returns True iff a new generation was published."""
         got = self.traffic.read_new()
         if got is not None:
+            # originating trace ids of the rows just ingested (the
+            # serving side stamped them into the log) become window
+            # provenance for the next publish.  The cap is enforced
+            # per-id: one backlog poll can carry hundreds of thousands
+            # of distinct ids, and the whole set lands in the meta
+            # sidecar AND the write-ahead intent — provenance, not a
+            # ledger, so the first CAP ids win
+            for t in self.traffic.last_trace_ids:
+                if len(self._window_traces) >= self._WINDOW_TRACES_CAP:
+                    break
+                if t is not None:
+                    self._window_traces.add(t)
             self._ingest(*got)
         if self._window is None or self._window.num_data < self.trigger:
             return False
@@ -389,24 +408,38 @@ class OnlineTrainer:
         window = self._window
         if window is None or window.num_data == 0:
             return False
-        t0 = time.perf_counter()
-        if self.mode == "continue":
-            stats = self._continue_boosting(window)
-        else:
-            if self._refitter is None:
-                self._refitter = LeafRefitter(self.booster._gbdt, window)
-            # exact raw-feature routing accumulated at ingestion; the
-            # binned router only backstops a count mismatch (e.g. rows
-            # appended to the window behind the trainer's back)
-            leaf = (np.concatenate(self._leaf_chunks)
-                    if self._leaf_chunks else None)
-            if leaf is not None and len(leaf) != window.num_data:
-                leaf = None
-            stats = self._refitter.refit(leaf_idx=leaf)
-        stats["refresh_seconds"] = round(time.perf_counter() - t0, 4)
-        self._publish(stats)
+        # ONE trace id spans the whole refresh — refit/continue,
+        # publish, and (via the meta sidecar) the serving registry's
+        # hot-swap adopt it, so the train half of the serve→train→serve
+        # loop is a single grep
+        with telemetry.span("online.refresh", mode=self.mode,
+                            rows=int(window.num_data),
+                            generation=self.generation + 1,
+                            origin_traces=len(self._window_traces)):
+            t0 = time.perf_counter()
+            if self.mode == "continue":
+                with telemetry.span("online.continue"):
+                    stats = self._continue_boosting(window)
+            else:
+                if self._refitter is None:
+                    self._refitter = LeafRefitter(self.booster._gbdt,
+                                                  window)
+                # exact raw-feature routing accumulated at ingestion;
+                # the binned router only backstops a count mismatch
+                # (e.g. rows appended to the window behind the
+                # trainer's back)
+                leaf = (np.concatenate(self._leaf_chunks)
+                        if self._leaf_chunks else None)
+                if leaf is not None and len(leaf) != window.num_data:
+                    leaf = None
+                with telemetry.span("online.refit",
+                                    rows=int(window.num_data)):
+                    stats = self._refitter.refit(leaf_idx=leaf)
+            stats["refresh_seconds"] = round(time.perf_counter() - t0, 4)
+            self._publish(stats)
         window.reset_rows()
         self._leaf_chunks = []
+        self._window_traces = set()
         self._published_offset = int(self.traffic.offset)
         self._record_refresh(ok=True, rows=stats.get("rows", 0))
         self._flush_state()
@@ -455,6 +488,13 @@ class OnlineTrainer:
                 # silent-data-loss visibility: the traffic reader's
                 # skip counters ride into /stats' `online` block
                 "traffic": self.traffic.counters(),
+                # trace propagation: the refresh's own trace id (the
+                # serving registry's hot-swap span adopts it) plus the
+                # originating serve-request ids this window was built
+                # from — the sidecar is the cross-process hop of the
+                # serve→train→serve loop
+                "trace_id": telemetry.current_trace_id(),
+                "origin_trace_ids": sorted(self._window_traces),
                 "published_unix": round(time.time(), 3), **stats}
         # write-ahead intent BEFORE anything touches publish_path: a
         # crash anywhere in the rename window is resolved on restart.
@@ -470,22 +510,26 @@ class OnlineTrainer:
             "offset": int(self.traffic.offset),
             "model_sha1": _file_sha1(tmp),
             "meta": meta})
-        # chaos seams: crash before anything lands / model file torn
-        # mid-write at the FINAL path (the no-tmp-discipline failure the
-        # registry's poll must survive) — tests/test_faults.py
-        faults.check("online.before_publish")
-        faults.torn_copy("online.publish_model", tmp, self.publish_path)
-        mtmp = f"{self.publish_path}.meta.json.tmp"
-        with open(mtmp, "w") as f:
-            json.dump(meta, f)
-        # both files staged before either lands: the model/sidecar
-        # inconsistency window a /stats poll can observe is two
-        # back-to-back renames, not a model save + json dump
-        os.replace(tmp, self.publish_path)
-        # chaos seam: crash with the model landed but the meta not —
-        # the case only the intent's model sha1 can disambiguate
-        faults.check("online.between_renames")
-        os.replace(mtmp, self.publish_path + ".meta.json")
+        with telemetry.span("online.publish", generation=gen,
+                            path=self.publish_path):
+            # chaos seams: crash before anything lands / model file
+            # torn mid-write at the FINAL path (the no-tmp-discipline
+            # failure the registry's poll must survive) —
+            # tests/test_faults.py
+            faults.check("online.before_publish")
+            faults.torn_copy("online.publish_model", tmp,
+                             self.publish_path)
+            mtmp = f"{self.publish_path}.meta.json.tmp"
+            with open(mtmp, "w") as f:
+                json.dump(meta, f)
+            # both files staged before either lands: the model/sidecar
+            # inconsistency window a /stats poll can observe is two
+            # back-to-back renames, not a model save + json dump
+            os.replace(tmp, self.publish_path)
+            # chaos seam: crash with the model landed but the meta not
+            # — the case only the intent's model sha1 can disambiguate
+            faults.check("online.between_renames")
+            os.replace(mtmp, self.publish_path + ".meta.json")
         self.generation = gen
         self.refreshes += 1
         faults.check("online.after_publish")

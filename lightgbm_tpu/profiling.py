@@ -10,14 +10,27 @@ environment switch here); totals print at interpreter exit or via
 from __future__ import annotations
 
 import atexit
+import math
 import os
 import threading
 import time
 from collections import defaultdict, deque
 from contextlib import contextmanager
-from typing import Deque, Dict, Iterator, Optional
+from typing import Deque, Dict, Iterator, Optional, Tuple
 
 ENABLED = os.environ.get("LIGHTGBM_TPU_TIMETAG", "0") not in ("0", "", "false")
+
+# telemetry.configure() flips this so the phase accumulators run (and
+# feed per-iteration records + /metrics) whenever span tracing is on,
+# without requiring the LIGHTGBM_TPU_TIMETAG env switch too
+_PHASES_FORCED = False
+
+
+def force_phases(on: bool = True) -> None:
+    """Force the phase accumulators on regardless of the TIMETAG env
+    switch (telemetry.configure does; telemetry.reset undoes)."""
+    global _PHASES_FORCED
+    _PHASES_FORCED = bool(on)
 
 _totals: Dict[str, float] = defaultdict(float)
 _counts: Dict[str, int] = defaultdict(int)
@@ -73,12 +86,23 @@ SERVE_REPLICA_BROKEN = "serve.replica_broken"
 SERVE_REPLICA_READMITTED = "serve.replica_readmitted"
 SERVE_REPLICA_PROBES = "serve.replica_probes"
 
+# Every canonical counter constant of this module, in one tuple: the
+# Prometheus exposition (telemetry.prometheus_text) seeds each of these
+# at 0 so a scrape always covers the full canonical set, and the
+# counter-name lint (scripts/check_counter_names.py) enforces that call
+# sites use the constants instead of re-typing the strings.
+CANONICAL_COUNTERS = (
+    HIST_ROWS_TOUCHED, HIST_EXCHANGE_BYTES, SPLIT_RECORDS_BYTES,
+    REGISTRY_SWAP_FAILURES, SERVE_CHUNK_RETRIES, SERVE_REPLICA_FAILURES,
+    SERVE_REPLICA_BROKEN, SERVE_REPLICA_READMITTED, SERVE_REPLICA_PROBES,
+)
+
 
 @contextmanager
 def phase(name: str, force: bool = False) -> Iterator[None]:
     """Accumulate wall-clock under `name`.  No-op unless enabled, except
     `force=True` (serving phases) which always accumulates."""
-    if not (ENABLED or force):
+    if not (ENABLED or force or _PHASES_FORCED):
         yield
         return
     t0 = time.perf_counter()
@@ -91,7 +115,7 @@ def phase(name: str, force: bool = False) -> Iterator[None]:
 
 
 def add(name: str, seconds: float, force: bool = False) -> None:
-    if ENABLED or force:
+    if ENABLED or force or _PHASES_FORCED:
         with _lock:
             _totals[name] += seconds
             _counts[name] += 1
@@ -138,10 +162,20 @@ def counter_value(name: str) -> float:
         return _counters.get(name, 0.0)
 
 
-def counters(prefix: str = "") -> Dict[str, float]:
+def counters(prefix: str = "", sync: bool = True) -> Dict[str, float]:
     with _lock:
-        _drain_deferred_locked()
+        if sync:
+            _drain_deferred_locked()
         return {k: v for k, v in _counters.items() if k.startswith(prefix)}
+
+
+def counters_nosync(prefix: str = "") -> Dict[str, float]:
+    """Host-visible counter values WITHOUT draining the deferred device
+    totals — safe on the pipelined training path (no device sync).
+    `count_deferred` accumulations lag until the next counters()/
+    snapshot() read pays the sync; counters recorded with count() are
+    exact.  The per-iteration training telemetry reads through here."""
+    return counters(prefix, sync=False)
 
 
 def observe(name: str, value: float) -> None:
@@ -153,17 +187,40 @@ def observe(name: str, value: float) -> None:
         dq.append(value)
 
 
+def _summary_of(vals) -> Dict[str, float]:
+    """Nearest-rank percentiles (ceil(p*n)-1) over pre-sorted samples.
+    The previous ``int(p * n)`` indexing overshot nearest-rank by one
+    position — p50 of [1, 2] returned 2 and p99 of 100 samples returned
+    the max — which matters because p99 is the SLO number the serve
+    bench gates on."""
+    if not vals:
+        return {"count": 0}
+
+    def q(p: float) -> float:
+        return vals[min(len(vals) - 1, max(0, math.ceil(p * len(vals)) - 1))]
+
+    return {"count": len(vals), "p50": q(0.50), "p95": q(0.95),
+            "p99": q(0.99), "max": vals[-1]}
+
+
 def summary(name: str) -> Dict[str, float]:
     """count/p50/p95/p99/max over the retained samples of `name` — p99
     is the serving SLO metric the sustained-QPS bench gates on."""
     with _lock:
         vals = sorted(_samples.get(name, ()))
-    if not vals:
-        return {"count": 0}
-    def q(p: float) -> float:
-        return vals[min(len(vals) - 1, int(p * len(vals)))]
-    return {"count": len(vals), "p50": q(0.50), "p95": q(0.95),
-            "p99": q(0.99), "max": vals[-1]}
+    return _summary_of(vals)
+
+
+def snapshot() -> Tuple[Dict[str, float], Dict[str, Dict[str, float]]]:
+    """ONE locked snapshot of the whole registry for a /metrics scrape:
+    (counters, {name: summary}) — deferred device totals drain here
+    (the scrape pays the sync, same contract as counters())."""
+    with _lock:
+        _drain_deferred_locked()
+        ctrs = dict(_counters)
+        sums = {name: _summary_of(sorted(dq))
+                for name, dq in _samples.items()}
+    return ctrs, sums
 
 
 def timings() -> Dict[str, float]:
@@ -204,10 +261,15 @@ if ENABLED:
 def device_trace(logdir: str) -> Iterator[None]:
     """jax.profiler trace wrapper — the TPU analog of the reference's GPU
     transfer/kernel timing logs (gpu_tree_learner.cpp:538-542).  View with
-    TensorBoard or xprof."""
+    TensorBoard or xprof.  Also emitted as a telemetry span carrying the
+    logdir, so the xprof device trace can be lined up against the host
+    span timeline under the same trace id (scripts/trace_view.py)."""
     import jax
+
+    from . import telemetry
     jax.profiler.start_trace(logdir)
     try:
-        yield
+        with telemetry.span("profiling.device_trace", logdir=logdir):
+            yield
     finally:
         jax.profiler.stop_trace()

@@ -35,7 +35,7 @@ from typing import Deque, List, Optional
 
 import numpy as np
 
-from .. import profiling
+from .. import profiling, telemetry
 from ..log import LightGBMError
 
 # monotonic clock for ALL deadline math — module-level and injectable so
@@ -49,13 +49,21 @@ class ServerOverloadedError(LightGBMError):
 
 
 class _Request:
-    __slots__ = ("X", "kind", "future", "t_enqueue")
+    __slots__ = ("X", "kind", "future", "t_enqueue", "trace_id",
+                 "parent_id")
 
-    def __init__(self, X: np.ndarray, kind: str):
+    def __init__(self, X: np.ndarray, kind: str,
+                 trace_id: Optional[str] = None,
+                 parent_id: Optional[str] = None):
         self.X = X
         self.kind = kind
         self.future: Future = Future()
         self.t_enqueue = _now()
+        # trace propagation across the queue: the flusher thread cannot
+        # inherit the HTTP handler thread's span context, so the ids
+        # ride the request object explicitly
+        self.trace_id = trace_id
+        self.parent_id = parent_id
 
 
 class MicroBatcher:
@@ -90,16 +98,20 @@ class MicroBatcher:
 
     # -- client side ----------------------------------------------------
 
-    def submit(self, X: np.ndarray, kind: str = "value") -> Future:
+    def submit(self, X: np.ndarray, kind: str = "value",
+               trace_id: Optional[str] = None,
+               parent_id: Optional[str] = None) -> Future:
         """Enqueue one request; the Future resolves to its predictions
-        (Booster.predict shapes) or raises the scoring error."""
+        (Booster.predict shapes) or raises the scoring error.
+        ``trace_id``/``parent_id`` tie the request's dispatch records to
+        the caller's span (the HTTP handler passes its ingress ids)."""
         X = np.ascontiguousarray(np.asarray(X, np.float64))
         if X.ndim == 1:
             X = X.reshape(1, -1)
         if X.ndim != 2 or X.shape[0] == 0:
             raise LightGBMError("predict request must be a non-empty "
                                 "[rows, features] matrix")
-        req = _Request(X, kind)
+        req = _Request(X, kind, trace_id, parent_id)
         with self._cond:
             if self._closed:
                 raise LightGBMError("batcher is closed")
@@ -202,20 +214,37 @@ class MicroBatcher:
         for (kind, _f), reqs in groups.items():
             X = (reqs[0].X if len(reqs) == 1
                  else np.concatenate([r.X for r in reqs], axis=0))
+            # the batch span runs under the OLDEST member's trace (its
+            # deadline shaped the flush); every member's own trace gets
+            # a `serve.dispatch` event naming the batch trace below, so
+            # any single trace id still reconstructs its whole path
+            leader = reqs[0]
             try:
-                preds = runtime.predict(X, kind=kind)
+                with telemetry.span(
+                        "serve.batch", trace_id=leader.trace_id,
+                        parent_id=leader.parent_id, kind=kind,
+                        rows=int(X.shape[0]), requests=len(reqs)):
+                    preds = runtime.predict(X, kind=kind)
             except Exception as e:
                 for req in reqs:
                     req.future.set_exception(e)
                 continue
             now = _now()
+            generation = getattr(runtime, "generation", 0)
             off = 0
             for req in reqs:
                 n = req.X.shape[0]
                 # stamp the scoring generation before set_result so a
                 # waiter that wakes on result() always sees it
-                req.future.generation = getattr(runtime, "generation", 0)
+                req.future.generation = generation
                 req.future.set_result(preds[off:off + n])
                 off += n
-                profiling.observe("serve.latency_ms",
-                                  (now - req.t_enqueue) * 1e3)
+                wait_ms = (now - req.t_enqueue) * 1e3
+                profiling.observe("serve.latency_ms", wait_ms)
+                telemetry.event(
+                    "serve.dispatch", trace_id=req.trace_id,
+                    parent_id=req.parent_id, rows=n, kind=kind,
+                    generation=generation,
+                    batch_trace=leader.trace_id,
+                    batch_requests=len(reqs),
+                    wait_ms=round(wait_ms, 3))

@@ -20,12 +20,13 @@ that pinned the previous runtime finish on it untouched.
 """
 from __future__ import annotations
 
+import json
 import os
 import signal
 import threading
 from typing import Optional, Sequence, Tuple
 
-from .. import log, profiling
+from .. import log, profiling, telemetry
 from ..log import LightGBMError
 from .runtime import OUTPUT_KINDS, PredictorRuntime
 
@@ -90,6 +91,27 @@ class ModelRegistry:
                                 replicas=self.replicas,
                                 failure_threshold=self.failure_threshold)
 
+    def _publish_trace_id(self) -> Optional[str]:
+        """The publishing refresh's trace id from the online trainer's
+        ``.meta.json`` sidecar (None for models published any other
+        way, or with telemetry off).  The sidecar is renamed AFTER the
+        model file; a poll landing inside that window (or after a crash
+        between the renames) would read the PREVIOUS refresh's sidecar
+        — attributing this swap to the wrong trace — so a sidecar older
+        than the model is not adopted."""
+        if not telemetry.enabled():
+            return None
+        meta_path = self.model_path + ".meta.json"
+        try:
+            if (os.stat(meta_path).st_mtime_ns
+                    < os.stat(self.model_path).st_mtime_ns):
+                return None
+            with open(meta_path) as f:
+                tid = json.load(f).get("trace_id")
+            return str(tid) if tid else None
+        except (OSError, ValueError):
+            return None
+
     def maybe_reload(self, force: bool = False) -> bool:
         """Swap in the model file if it changed; True iff a swap landed.
 
@@ -111,7 +133,16 @@ class ModelRegistry:
                 return False
             old = self._runtime
             try:
-                with profiling.phase("serve/swap", force=True):
+                # the swap span ADOPTS the publishing refresh's trace id
+                # (the online trainer stamps it into the .meta.json
+                # sidecar), closing the serve→train→serve loop: one
+                # grep for that id finds traffic → window → refit →
+                # publish → this hot-swap
+                with telemetry.span(
+                        "serve.swap", trace_id=self._publish_trace_id(),
+                        generation=old.generation + 1,
+                        model_path=self.model_path), \
+                        profiling.phase("serve/swap", force=True):
                     runtime = self._load(generation=old.generation + 1)
                     # warm every bucket the outgoing generation served,
                     # for BOTH this registry's warmup kinds and whatever

@@ -53,7 +53,7 @@ from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .. import log, profiling
+from .. import log, profiling, telemetry
 from ..diagnostics import faults
 from ..log import LightGBMError
 
@@ -413,14 +413,20 @@ class PredictorRuntime:
             return best
 
     def _note_success(self, replica: _Replica) -> None:
+        readmitted = False
         with self._lock:
             replica.failures = 0
             if replica.broken:
                 replica.broken = False
                 replica.skips = 0
+                readmitted = True
                 profiling.count(profiling.SERVE_REPLICA_READMITTED)
-                log.info(f"serving replica {replica.index} readmitted "
-                         "(half-open probe succeeded)")
+        if readmitted:
+            log.info(f"serving replica {replica.index} readmitted "
+                     "(half-open probe succeeded)")
+            telemetry.event("serve.breaker", replica=replica.index,
+                            state="closed",
+                            generation=self.generation)
 
     def _note_failure(self, replica: _Replica, error: BaseException) -> None:
         with self._lock:
@@ -441,6 +447,11 @@ class PredictorRuntime:
                 f"{replica.failures} consecutive failures "
                 f"({type(error).__name__}: {error}); traffic fails over "
                 "to the surviving replicas")
+        if opened or reopened:
+            telemetry.event("serve.breaker", replica=replica.index,
+                            state="open" if opened else "probe_failed",
+                            error=f"{type(error).__name__}: {error}",
+                            generation=self.generation)
 
     def _run_compiled(self, bucket: int, kind: str, Xpad: np.ndarray,
                       replica: Optional[_Replica] = None,
@@ -458,19 +469,26 @@ class PredictorRuntime:
                 replica.inflight += 1
                 replica.dispatches += 1
         try:
-            # chaos seams: a dispatch raising (any replica / THIS
-            # replica) is the circuit breaker's trigger condition
-            faults.check("serve.dispatch")
-            faults.check(f"serve.dispatch.r{replica.index}")
-            exe = self._get_executable(replica, bucket, kind)
-            # explicit device_put/device_get keeps the serving loop clean
-            # under the sanitizer's transfer guard (BENCH_SANITIZE in
-            # scripts/bench_serve.py): implicit conversions here would be
-            # one h2d + one d2h violation per request
-            out = exe(replica.stacks,
-                      jax.device_put(Xpad.astype(np.float32, copy=False),
-                                     replica.device))
-            res = jax.device_get(out).astype(np.float64)  # [K, bucket]
+            # the replica-level hop of a request's trace: which chip ran
+            # this chunk, at which bucket/kind, under which generation
+            with telemetry.span("serve.replica", replica=replica.index,
+                                bucket=bucket, kind=kind,
+                                generation=self.generation):
+                # chaos seams: a dispatch raising (any replica / THIS
+                # replica) is the circuit breaker's trigger condition
+                faults.check("serve.dispatch")
+                faults.check(f"serve.dispatch.r{replica.index}")
+                exe = self._get_executable(replica, bucket, kind)
+                # explicit device_put/device_get keeps the serving loop
+                # clean under the sanitizer's transfer guard
+                # (BENCH_SANITIZE in scripts/bench_serve.py): implicit
+                # conversions here would be one h2d + one d2h violation
+                # per request
+                out = exe(replica.stacks,
+                          jax.device_put(Xpad.astype(np.float32,
+                                                     copy=False),
+                                         replica.device))
+                res = jax.device_get(out).astype(np.float64)  # [K, bucket]
         except Exception as e:
             self._note_failure(replica, e)
             if pinned:                 # warmup: surface the raw error
@@ -554,9 +572,13 @@ class PredictorRuntime:
                 # does fan out: chunks dispatch CONCURRENTLY (each
                 # dispatch picks the least-loaded replica), so
                 # wall-clock is ~chunks/replicas slabs, not a
-                # sequential scan that merely rotates replicas
+                # sequential scan that merely rotates replicas.  The
+                # caller's span context rides into the pool threads
+                # explicitly (thread locals do not follow map work).
+                ctx = telemetry.current()
                 parts = list(self._fanout.map(
-                    lambda a: self._predict_chunk(
+                    lambda a: telemetry.call_in_context(
+                        ctx, self._predict_chunk,
                         X[a:a + self.max_batch_rows], run_kind),
                     starts))
         raw = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=1)

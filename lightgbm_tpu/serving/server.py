@@ -5,11 +5,19 @@ Endpoints:
   of feature values, or ``{"features": [...]}``.  A single JSON object
   ``{"rows": [[...], ...]}`` is also accepted.  Response is JSON lines,
   one prediction per input row (a number, or an array for multiclass).
-  ``?raw_score=1`` returns raw margins.
+  ``?raw_score=1`` returns raw margins.  A trace id rides in via the
+  ``X-Trace-Id`` header or a ``"trace_id"`` field in the object body
+  (one is generated when telemetry is on and none arrives); the
+  response echoes it as ``X-Trace-Id``, and the request's whole path —
+  ingress span → batcher dispatch → replica execution — shares it
+  (docs/Observability.md).
 - ``GET /healthz`` — liveness + active model generation.
 - ``GET /stats`` — request/row/batch counters, compiled-predictor cache
-  hits/misses, latency percentiles, queue depth, swap history, and the
-  profiling phase totals.
+  hits/misses, latency percentiles, queue depth, swap history, the
+  profiling phase totals, and the ``process`` block (uptime, RSS, jax
+  backend/devices, version, telemetry config).
+- ``GET /metrics`` — Prometheus text exposition of the profiling
+  registry + serve gauges (telemetry.prometheus_text).
 
 Wired into the CLI as ``task=serve`` (application.py): requests flow
 HTTP handler → MicroBatcher → PredictorRuntime, with ModelRegistry
@@ -18,14 +26,15 @@ hot-swapping generations underneath.
 from __future__ import annotations
 
 import json
+import re
 import threading
 from concurrent.futures import TimeoutError as _FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Optional, Tuple
 
 import numpy as np
 
-from .. import log, profiling
+from .. import log, profiling, telemetry
 from ..config import Config
 from ..log import LightGBMError
 from .batcher import MicroBatcher, ServerOverloadedError
@@ -33,17 +42,22 @@ from .registry import ModelRegistry
 from .runtime import NoHealthyReplicaError
 
 
-def _parse_predict_body(body: bytes) -> np.ndarray:
+def _parse_predict_body(body: bytes) -> Tuple[np.ndarray, Optional[str]]:
+    """Rows plus the optional ``trace_id`` field of the object form."""
     text = body.decode("utf-8").strip()
     if not text:
         raise ValueError("empty request body")
     obj = None
+    trace_id: Optional[str] = None
     if text.startswith("{"):
         try:                                 # whole-body object form,
             obj = json.loads(text)           # pretty-printed or not
         except json.JSONDecodeError:
             obj = None                       # fall through to JSON lines
     if obj is not None:
+        tid = obj.get("trace_id")
+        if tid:
+            trace_id = str(tid)
         if "rows" in obj:
             rows = obj["rows"]
         elif "features" in obj:
@@ -61,7 +75,12 @@ def _parse_predict_body(body: bytes) -> np.ndarray:
     X = np.asarray(rows, dtype=np.float64)
     if X.ndim != 2:
         raise ValueError("rows must all have the same feature count")
-    return X
+    return X, trace_id
+
+
+# client-supplied trace ids must be header-safe and bounded before they
+# are echoed or persisted (see do_POST)
+_TRACE_ID_RE = re.compile(r"^[A-Za-z0-9._:-]{1,128}$")
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -90,6 +109,12 @@ class _Handler(BaseHTTPRequestHandler):
                                      "generation": srv.registry.generation})
         elif path == "/stats":
             self._respond_json(200, srv.stats())
+        elif path == "/metrics":
+            # Prometheus text exposition; the scrape drains any pending
+            # deferred device counters (it pays the sync, by contract)
+            self._respond(200, srv.metrics_text().encode(),
+                          content_type="text/plain; version=0.0.4; "
+                                       "charset=utf-8")
         else:
             self._respond_json(404, {"error": f"unknown path {path}"})
 
@@ -107,19 +132,39 @@ class _Handler(BaseHTTPRequestHandler):
         if path != "/predict":
             self._respond_json(404, {"error": f"unknown path {path}"})
             return
+        trace_id = None
         try:
             from urllib.parse import parse_qs
-            X = _parse_predict_body(body)
+            X, body_trace = _parse_predict_body(body)
+            # trace ingress: header first, then the body field; with
+            # telemetry on and neither present, this server MINTS the id
+            # so the request is traceable end-to-end regardless of the
+            # client.  Ids are VALIDATED at ingress: the body field is
+            # attacker-shaped bytes echoed into the X-Trace-Id response
+            # header (CR/LF there is header injection) and written into
+            # spans/the traffic log — a malformed id is dropped, not
+            # propagated.
+            raw_tid = self.headers.get("X-Trace-Id") or body_trace
+            trace_id = (raw_tid if raw_tid is not None
+                        and _TRACE_ID_RE.match(raw_tid) else None)
+            if trace_id is None and telemetry.enabled():
+                trace_id = telemetry.new_trace_id()
             qs = parse_qs(query)
             raw = (qs["raw_score"][0] in ("1", "true")
                    if "raw_score" in qs else srv.default_raw)
             kind = "raw" if raw else "value"
-            fut = srv.batcher.submit(X, kind=kind)
-            preds = fut.result(timeout=srv.request_timeout_s)
-            # the generation that actually scored this batch (pinned by
-            # the flusher), not whatever is live at response time
-            generation = getattr(fut, "generation",
-                                 srv.registry.generation)
+            with telemetry.span("serve.request", trace_id=trace_id,
+                                rows=int(X.shape[0]), kind=kind) as sp:
+                fut = srv.batcher.submit(
+                    X, kind=kind, trace_id=trace_id,
+                    parent_id=sp.span_id)
+                preds = fut.result(timeout=srv.request_timeout_s)
+                # the generation that actually scored this batch
+                # (pinned by the flusher), not whatever is live at
+                # response time
+                generation = getattr(fut, "generation",
+                                     srv.registry.generation)
+                sp.set(generation=generation)
         except (ValueError, KeyError, json.JSONDecodeError) as e:
             self._respond_json(400, {"error": str(e)})
             return
@@ -148,6 +193,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(200)
         self.send_header("Content-Type", "application/jsonlines")
         self.send_header("X-Model-Generation", str(generation))
+        if trace_id:
+            self.send_header("X-Trace-Id", trace_id)
         out = lines.encode()
         self.send_header("Content-Length", str(len(out)))
         self.end_headers()
@@ -218,10 +265,33 @@ class PredictionServer:
             meta["daemon"] = state
         return meta
 
+    def _serve_gauges(self) -> dict:
+        """Live fleet gauges for the /metrics exposition — the state a
+        counter cannot carry (current queue depth, healthy replicas,
+        the generation in service)."""
+        runtime = self.registry.current()
+        return {
+            "serve.queue_depth": self.batcher.queue_depth,
+            "serve.pending_rows_cap": self.batcher.max_pending_rows,
+            "serve.batch_workers": self.batcher.workers,
+            "serve.replicas": getattr(runtime, "replica_count", 1),
+            "serve.healthy_replicas": (runtime.healthy_count()
+                                       if hasattr(runtime, "healthy_count")
+                                       else 1),
+            "serve.model_generation": self.registry.generation,
+            "serve.swaps": self.registry.swaps,
+        }
+
+    def metrics_text(self) -> str:
+        return telemetry.prometheus_text(self._serve_gauges())
+
     def stats(self) -> dict:
         runtime = self.registry.current()
         return {
             "generation": self.registry.generation,
+            # uptime / RSS / backend / version / telemetry config — the
+            # operator's "which process is this" block
+            "process": telemetry.process_info(),
             "model_path": self.registry.model_path,
             # generation metadata published by the task=online trainer
             # (lightgbm_tpu/online/trainer.py), when this model is one

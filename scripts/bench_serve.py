@@ -179,7 +179,12 @@ def _sustained_load(server, X):
         return {"error": str(errors[:3])}
 
     def q(p):
-        return round(lat[min(len(lat) - 1, int(p * len(lat)))] * 1e3, 3)
+        # nearest-rank (ceil(p*n)-1), matching profiling.summary — the
+        # old int(p*n) indexing overshot by one position (p99 of 100
+        # samples reported the max)
+        import math
+        i = min(len(lat) - 1, max(0, math.ceil(p * len(lat)) - 1))
+        return round(lat[i] * 1e3, 3)
 
     return {
         "seconds": round(wall, 2),

@@ -199,6 +199,26 @@ def main():
         "features; small values (e.g. `0.01`) trade exactness for more "
         "compaction, like the reference's EFB.",
         "",
+        "## Observability",
+        "",
+        "- `telemetry_path` (default `''`, aliases `telemetry`, "
+        "`trace_path`, `span_path`): structured span tracing — every "
+        "process role appends JSONL span/event records "
+        "(trace-id/span-id/parent-id, monotonic durations) to this "
+        "path, with trace ids propagated end-to-end through the "
+        "serve→train→serve loop.  Convert with "
+        "`scripts/trace_view.py` (chrome://tracing / Perfetto).  Empty "
+        "= off; the hot paths then cost one cached check.  The "
+        "`LIGHTGBM_TPU_TELEMETRY` env var is the config-free switch.  "
+        "See `docs/Observability.md`.",
+        "- `metrics_port` (default `0`, aliases `prometheus_port`, "
+        "`telemetry_port`): standalone Prometheus /metrics listener "
+        "for roles without their own HTTP server (`task=train`, "
+        "`task=online`, `task=predict`) — profiling counters, "
+        "nearest-rank latency quantiles, process/device gauges in text "
+        "exposition format.  `0` = off.  `task=serve` always serves "
+        "the same payload at its own `/metrics` endpoint.",
+        "",
     ]
     dest = os.path.join(ROOT, "docs", "Parameters.md")
     os.makedirs(os.path.dirname(dest), exist_ok=True)

@@ -233,18 +233,29 @@ def main():
     # rides along in the committed artifact
     from lightgbm_tpu.diagnostics.sanitize import (HotPathSanitizer,
                                                    sanitize_enabled)
+    # BENCH_TRACE=<logdir>: xprof device trace of the same timed loop,
+    # artifact dir recorded in the committed JSON (chip-queue windows
+    # capture the device profile beside the MFU numbers for free)
+    import contextlib
+    from lightgbm_tpu import profiling
+    trace_dir = os.environ.get("BENCH_TRACE", "")
+    trace_ctx = (profiling.device_trace(trace_dir) if trace_dir
+                 else contextlib.nullcontext())
     san = None
     t0 = time.perf_counter()
-    if sanitize_enabled():
-        san = HotPathSanitizer(warmup=1, label="profile_hotpath")
-        with san:
+    with trace_ctx:
+        if sanitize_enabled():
+            san = HotPathSanitizer(warmup=1, label="profile_hotpath")
+            with san:
+                for _ in range(10):
+                    with san.step():
+                        bst.update()
+        else:
             for _ in range(10):
-                with san.step():
-                    bst.update()
-    else:
-        for _ in range(10):
-            bst.update()
+                bst.update()
     _force(bst._gbdt.train_score.score)
+    if trace_dir:
+        rec["device_trace_dir"] = trace_dir
     full = (time.perf_counter() - t0) / 10
     rec["full_update_ms"] = round(full * 1e3, 1)
     if san is not None:

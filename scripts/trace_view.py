@@ -1,0 +1,124 @@
+"""Convert a telemetry span JSONL (`telemetry_path`) into
+chrome://tracing / Perfetto ``trace_event`` JSON.
+
+Every span becomes a complete event ("ph": "X") and every point event
+an instant ("ph": "i"); the process ROLE (train / serve / online / ...)
+becomes the pid lane and the thread name the tid lane, with
+process_name/thread_name metadata so the UI labels them.  Span args
+carry the trace/span/parent ids and the span attrs, so clicking any
+slice shows which request/refresh it belonged to — and
+``profiling.device_trace`` spans carry their xprof logdir, which is how
+a device trace is lined up against the host timeline of the same trace
+id.
+
+Usage:
+
+    python scripts/trace_view.py spans.jsonl [out.json]
+    # default out: <in>.trace.json — open in chrome://tracing or
+    # https://ui.perfetto.dev
+
+    python scripts/trace_view.py spans.jsonl --trace <trace-id> ...
+    # keep only one trace id's records (the "why is THIS request slow"
+    # view)
+"""
+import json
+import sys
+from typing import Dict, Iterable, List, Optional
+
+
+def convert(records: Iterable[dict],
+            only_trace: Optional[str] = None) -> Dict[str, list]:
+    """Telemetry records -> {"traceEvents": [...]} (trace_event JSON).
+
+    Unknown/malformed records are skipped (the JSONL may have a torn
+    tail from a live writer); the count is reported by main()."""
+    pids: Dict[str, int] = {}
+    tids: Dict[tuple, int] = {}
+    events: List[dict] = []
+
+    def pid_of(proc: str) -> int:
+        if proc not in pids:
+            pids[proc] = len(pids) + 1
+            events.append({"ph": "M", "name": "process_name",
+                           "pid": pids[proc], "tid": 0,
+                           "args": {"name": proc}})
+        return pids[proc]
+
+    def tid_of(pid: int, thread: str) -> int:
+        key = (pid, thread)
+        if key not in tids:
+            tids[key] = len(tids) + 1
+            events.append({"ph": "M", "name": "thread_name",
+                           "pid": pid, "tid": tids[key],
+                           "args": {"name": thread}})
+        return tids[key]
+
+    for rec in records:
+        if not isinstance(rec, dict) or "name" not in rec or "ts" not in rec:
+            continue
+        if only_trace is not None and rec.get("trace") != only_trace:
+            continue
+        pid = pid_of(str(rec.get("proc", "main")))
+        tid = tid_of(pid, str(rec.get("thread", "main")))
+        args = dict(rec.get("attrs") or {})
+        for key in ("trace", "span", "parent", "status", "error"):
+            if rec.get(key) is not None:
+                args[key] = rec[key]
+        ev = {"name": rec["name"], "cat": rec.get("kind", "span"),
+              "pid": pid, "tid": tid,
+              "ts": float(rec["ts"]) * 1e6, "args": args}
+        if rec.get("kind") == "event":
+            ev["ph"] = "i"
+            ev["s"] = "t"                  # thread-scoped instant
+        else:
+            ev["ph"] = "X"
+            ev["dur"] = max(float(rec.get("dur_ms", 0.0)) * 1e3, 1.0)
+        events.append(ev)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def load_jsonl(path: str):
+    """Yield parsed records, counting lines that do not parse."""
+    bad = 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except ValueError:
+                bad += 1
+    if bad:
+        print(f"note: skipped {bad} unparseable line(s) "
+              "(torn tail from a live writer is normal)",
+              file=sys.stderr)
+
+
+def main(argv: List[str]) -> int:
+    args = [a for a in argv if not a.startswith("--")]
+    only_trace = None
+    if "--trace" in argv:
+        i = argv.index("--trace")
+        if i + 1 >= len(argv):
+            print("--trace needs a trace id", file=sys.stderr)
+            return 2
+        only_trace = argv[i + 1]
+        args = [a for a in args if a != only_trace]
+    if not args:
+        print(__doc__, file=sys.stderr)
+        return 2
+    src = args[0]
+    dst = args[1] if len(args) > 1 else src + ".trace.json"
+    trace = convert(load_jsonl(src), only_trace=only_trace)
+    with open(dst, "w") as f:
+        json.dump(trace, f)
+    n = sum(1 for e in trace["traceEvents"] if e["ph"] in ("X", "i"))
+    print(f"wrote {dst}: {n} events "
+          f"({len([e for e in trace['traceEvents'] if e['ph'] == 'M'])} "
+          "metadata rows); open in chrome://tracing or ui.perfetto.dev")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
