@@ -173,6 +173,19 @@ class Application:
                 "finished": "early_stop" if stopped_early else "complete"})
         gbdt.save_model_to_file(cfg.output_model)
         _log(cfg, f"finished training, model saved to {cfg.output_model}")
+        if cfg.serve_quantize != "raw":
+            # ship the frozen-mapper sidecar beside the model so the
+            # serving registry (and the online daemon, which adopts it)
+            # can quantize requests against the model's OWN training
+            # mappers — the refbin contract behind serve_quantize=binned
+            try:
+                train_raw.save_refbin(cfg.output_model + ".refbin")
+                _log(cfg, "frozen bin mappers saved to "
+                          f"{cfg.output_model}.refbin")
+            except OSError as e:
+                log.warning(f"could not save the refbin sidecar "
+                            f"({type(e).__name__}: {e}); binned serving "
+                            "of this model will fall back to raw")
 
     # ------------------------------------------------------------------
     def _predict(self) -> None:
@@ -188,7 +201,9 @@ class Application:
         predictor = Predictor(bst, raw_score=cfg.is_predict_raw_score,
                               leaf_index=cfg.is_predict_leaf_index,
                               num_iteration=cfg.num_iteration_predict,
-                              predict_kernel=cfg.predict_kernel)
+                              predict_kernel=cfg.predict_kernel,
+                              serve_quantize=cfg.serve_quantize,
+                              refbin=cfg.input_model + ".refbin")
         predictor.predict_file(cfg.data, cfg.output_result,
                                has_header=cfg.has_header,
                                label_idx=_label_idx(cfg))
@@ -255,7 +270,8 @@ class Predictor:
 
     def __init__(self, booster: Booster, raw_score: bool = False,
                  leaf_index: bool = False, num_iteration: int = -1,
-                 runtime=None, predict_kernel=None):
+                 runtime=None, predict_kernel=None,
+                 serve_quantize: str = "raw", refbin=None):
         self.booster = booster
         self.raw_score = raw_score
         self.leaf_index = leaf_index
@@ -264,11 +280,16 @@ class Predictor:
         gbdt._flush_pending()
         if runtime is None and not leaf_index and gbdt.models:
             # zero-tree models keep the host path: Booster.predict
-            # returns the baseline score, nothing to compile
-            from .serving.runtime import PredictorRuntime
-            runtime = PredictorRuntime(booster, num_iteration=num_iteration,
-                                       max_batch_rows=262_144,
-                                       predict_kernel=predict_kernel)
+            # returns the baseline score, nothing to compile.  Batch
+            # prediction shares the serving runtime, so it shares the
+            # serve_quantize dial too (resolve_runtime owns the
+            # auto/binned/raw policy): binned requires the model's
+            # .refbin mapper sidecar, auto falls back to raw without one
+            from .serving.runtime import resolve_runtime
+            runtime = resolve_runtime(
+                booster, serve_quantize=serve_quantize, refbin=refbin,
+                num_iteration=num_iteration, max_batch_rows=262_144,
+                predict_kernel=predict_kernel)
         self.runtime = runtime
 
     def predict(self, X: np.ndarray) -> np.ndarray:

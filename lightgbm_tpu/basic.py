@@ -277,6 +277,14 @@ class Dataset:
         self._inner.save_binary(filename)
         return self
 
+    def save_refbin(self, filename: str) -> "Dataset":
+        """Persist only the frozen bin-mapper set — the serving
+        registry's ``.refbin`` sidecar for ``serve_quantize=binned``
+        with offline-trained models (docs/serving.md)."""
+        self.construct()
+        self._inner.save_refbin(filename)
+        return self
+
     def num_data(self) -> int:
         self.construct()
         return self._inner.num_data

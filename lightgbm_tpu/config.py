@@ -18,6 +18,13 @@ from typing import Any, Dict, List, Optional, Tuple
 # check against ONE tuple and can't drift
 PREDICT_KERNELS = ("auto", "tensorized", "walk")
 
+# the serve_quantize dial's legal values — request-path feature
+# quantization (docs/serving.md "Binned inference"): "binned" serves
+# integer bins end-to-end against the model's .refbin frozen-mapper
+# sidecar, "raw" keeps f32 feature traversal, "auto" picks binned
+# whenever a valid sidecar is present
+SERVE_QUANTIZE_MODES = ("auto", "binned", "raw")
+
 # Alias table: parity with reference config.h:342-436 (ParameterAlias).
 PARAM_ALIASES: Dict[str, str] = {
     "config": "config_file",
@@ -120,6 +127,8 @@ PARAM_ALIASES: Dict[str, str] = {
     "pending_rows_cap": "max_pending_rows",
     "prediction_kernel": "predict_kernel",
     "predict_engine": "predict_kernel",
+    "serving_quantize": "serve_quantize",
+    "quantized_serving": "serve_quantize",
     # online learning (task=online / task=refit, lightgbm_tpu/online/)
     "decay_rate": "refit_decay_rate",
     "refit_decay": "refit_decay_rate",
@@ -412,6 +421,16 @@ class Config:
     # failures a replica stops receiving traffic; a periodic half-open
     # probe readmits it once it answers again (docs/Robustness.md).
     replica_failure_threshold: int = 3
+    # request-path feature quantization (docs/serving.md "Binned
+    # inference"): "binned" quantizes each request chunk against the
+    # model's .refbin frozen-mapper sidecar at ingress and traverses
+    # integer bins end-to-end — bit-identical scores to the raw kernel,
+    # a 4x smaller device request buffer — refusing to serve/swap when
+    # the sidecar is missing, torn, or sha1-mismatched vs the publish
+    # meta; "raw" keeps f32 feature traversal; "auto" picks binned
+    # whenever a valid sidecar is present and falls back to raw
+    # otherwise.
+    serve_quantize: str = "auto"
 
     # -- fault tolerance (task=train checkpoint/resume, docs/Robustness.md)
     # when set, training snapshots (model + iteration + early-stopping +
@@ -600,6 +619,9 @@ def check_param_conflict(cfg: Config) -> None:
         raise ValueError("checkpoint_interval must be >= 0 (0 = off)")
     if cfg.predict_kernel not in PREDICT_KERNELS:
         raise ValueError(f"unknown predict_kernel: {cfg.predict_kernel}")
+    if cfg.serve_quantize not in SERVE_QUANTIZE_MODES:
+        raise ValueError(f"unknown serve_quantize: {cfg.serve_quantize}; "
+                         f"use one of {SERVE_QUANTIZE_MODES}")
     if not (0.0 <= cfg.refit_decay_rate <= 1.0):
         raise ValueError("refit_decay_rate must be in [0, 1]")
     if cfg.refit_min_rows < 0:

@@ -26,8 +26,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .binning import (BinMapper, BundlePlan, find_bin_mappers,
-                      pack_bundle_column, plan_bundles, CATEGORICAL,
-                      NUMERICAL)
+                      plan_bundles, CATEGORICAL)
 from .config import Config
 
 # rows used to estimate pairwise feature conflicts when planning bundles;
@@ -618,53 +617,27 @@ class Dataset:
         return ds
 
     def _bin_rows_into(self, X: np.ndarray, row0: int) -> None:
-        """Bin raw rows X into self.bins[:, row0:row0+len(X)], using the
-        native bulk binner for uint8 numerical columns when built.  With
-        a bundle plan, packed features fold into their shared column
-        (last writer wins on conflicting rows; realized conflicts are
-        counted into `bundle_conflict_rows`)."""
-        dtype = self.bins.dtype
-        plan = self.bundle_plan
-        sl = slice(row0, row0 + len(X))
-        num_ks = [k for k, i in enumerate(self.used_features)
-                  if self.mappers[i].bin_type == NUMERICAL
-                  and (plan is None or not plan.feat_packed[k])]
-        done = set()
-        if dtype == np.uint8 and num_ks:
-            from .native import bin_numerical_native
-            cols = [self.used_features[k] for k in num_ks]
-            uppers = [self.mappers[i].bin_upper_bound for i in cols]
-            out = bin_numerical_native(np.ascontiguousarray(X), cols, uppers)
-            if out is not None:
-                for j, k in enumerate(num_ks):
-                    c = k if plan is None else int(plan.feat_col[k])
-                    self.bins[c, sl] = out[j]
-                done = set(num_ks)
-        for k, i in enumerate(self.used_features):
-            if k in done:
-                continue
-            b = self.mappers[i].value_to_bin(X[:, i])
-            if plan is None or not plan.feat_packed[k]:
-                c = k if plan is None else int(plan.feat_col[k])
-                self.bins[c, sl] = b.astype(dtype)
-            else:
-                self.bundle_conflict_rows += pack_bundle_column(
-                    b, int(plan.feat_default[k]), int(plan.feat_offset[k]),
-                    self.bins[int(plan.feat_col[k]), sl])
+        """Bin raw rows X into self.bins[:, row0:row0+len(X)] through
+        the SHARED quantization module (quantize.bin_rows_into — the
+        train-policy mapper application dataset construction, streaming
+        ingestion, and the serving ingress all derive from, so mappers
+        can never drift between train and serve).  With a bundle plan,
+        packed features fold into their shared column (last writer wins
+        on conflicting rows; realized conflicts are counted into
+        `bundle_conflict_rows`)."""
+        from .quantize import bin_rows_into
+        self.bundle_conflict_rows += bin_rows_into(
+            X, self.mappers, self.used_features, self.bundle_plan,
+            self.bins, row0)
 
     def _bin_column_into(self, k: int, values: np.ndarray) -> None:
         """Bin ONE used feature's full raw column into the store — the
         column-streaming entry the scipy-CSC path uses so the dense
         [N, F] matrix never materializes."""
-        plan = self.bundle_plan
-        b = self.mappers[self.used_features[k]].value_to_bin(values)
-        if plan is None or not plan.feat_packed[k]:
-            c = k if plan is None else int(plan.feat_col[k])
-            self.bins[c, :] = b.astype(self.bins.dtype)
-        else:
-            self.bundle_conflict_rows += pack_bundle_column(
-                b, int(plan.feat_default[k]), int(plan.feat_offset[k]),
-                self.bins[int(plan.feat_col[k])])
+        from .quantize import bin_column_into
+        self.bundle_conflict_rows += bin_column_into(
+            k, values, self.mappers, self.used_features,
+            self.bundle_plan, self.bins)
 
     # -- streaming append path (online ingestion; ROADMAP items 1 + 5) ------
     #
@@ -1025,6 +998,20 @@ class Dataset:
             f.write(self.BINARY_MAGIC.encode() + b"\n")
             np.savez_compressed(f, **arrays)
 
+    def save_refbin(self, path: str) -> None:
+        """Persist ONLY the frozen mapper set (+ bundle plan + used
+        features) as a 0-row binary-dataset shell — the serving
+        registry's ``.refbin`` sidecar contract for models trained
+        offline (docs/serving.md "Binned inference"; the online trainer
+        publishes its whole window store instead).  Loads back through
+        `quantize.load_refbin` / `from_binary` like any binary
+        dataset."""
+        shell = Dataset._empty_from_mappers(
+            self.config, self.mappers, list(self.used_features), 0,
+            self.num_total_features, list(self.feature_names),
+            plan=self.bundle_plan)
+        shell.save_binary(path)
+
     @classmethod
     def from_binary(cls, path: str, config: Optional[Config] = None
                     ) -> "Dataset":
@@ -1036,6 +1023,15 @@ class Dataset:
                     f"{path} is not a lightgbm_tpu binary dataset")
             npz = np.load(f, allow_pickle=False)
             d = {k: npz[k] for k in npz.files}  # materialize before close
+        return cls._from_binary_dict(d, cfg, path)
+
+    @classmethod
+    def _from_binary_dict(cls, d: Dict[str, np.ndarray], cfg: Config,
+                          path: str) -> "Dataset":
+        """Rebuild a Dataset from the already-parsed npz payload — the
+        body of `from_binary`, split out so `quantize.load_refbin` can
+        hash + parse a sidecar's bytes ONCE instead of re-reading the
+        file per stage (`path` is for error messages only)."""
         if int(d["max_bin"]) != cfg.max_bin:
             raise ValueError(
                 f"binary dataset {path} was built with max_bin="

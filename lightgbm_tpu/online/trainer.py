@@ -47,7 +47,6 @@ poll and flushes state before exit.
 from __future__ import annotations
 
 import dataclasses
-import hashlib
 import json
 import os
 import signal
@@ -69,11 +68,8 @@ STATE_VERSION = 1
 
 
 def _file_sha1(path: str) -> str:
-    h = hashlib.sha1()
-    with open(path, "rb") as f:
-        for chunk in iter(lambda: f.read(1 << 20), b""):
-            h.update(chunk)
-    return h.hexdigest()
+    from ..quantize import file_sha1
+    return file_sha1(path)
 
 
 def _booster_params(cfg: Config) -> dict:
@@ -141,6 +137,8 @@ class OnlineTrainer:
                 reference, cfg, capacity=self.trigger)
         if resume:
             self._try_resume()
+        if self._window is None:
+            self._adopt_input_refbin()
 
     @classmethod
     def from_config(cls, cfg: Config) -> "OnlineTrainer":
@@ -324,6 +322,41 @@ class OnlineTrainer:
         os.replace(tmp, self.refbin_path)
         self._mapper_fp = _file_sha1(self.refbin_path)
 
+    def _adopt_input_refbin(self) -> None:
+        """Freeze the INPUT model's own training mappers when it ships
+        a ``.refbin`` sidecar (Dataset.save_refbin at train time).
+        Ingestion then bins against the exact mapper set the model's
+        thresholds live in, so the published ``<output>.refbin`` stays
+        SERVING-exact across refit generations — the binned request
+        path (serve_quantize=binned) requires thresholds to BE bin
+        boundaries of the sidecar's mappers — and the binned refit
+        router becomes exact as a bonus.  Without a sidecar the first
+        full window freezes its own mappers, as before (such
+        generations serve raw under serve_quantize=auto: the serving
+        registry's representability check refuses them)."""
+        ip = str(getattr(self.cfg, "input_model", "") or "")
+        if not ip or not os.path.exists(ip + ".refbin"):
+            return
+        from ..quantize import load_refbin
+        try:
+            ref = load_refbin(ip + ".refbin")
+            if ref.num_total_features != self.booster.num_feature():
+                raise LightGBMError(
+                    f"sidecar covers {ref.num_total_features} features, "
+                    f"model has {self.booster.num_feature()}")
+            self._window = RawDataset.streaming_from(
+                ref, self.cfg, capacity=self.trigger)
+            self._save_refbin(ref)
+            log.info(f"online: adopted frozen mappers from {ip}.refbin "
+                     f"({ref.num_features} used features) — published "
+                     "generations stay binned-serving exact")
+        except Exception as e:
+            self._window = None
+            log.warning(f"online: could not adopt {ip}.refbin "
+                        f"({type(e).__name__}: {e}); the first "
+                        f"{self.trigger}-row window will freeze its own "
+                        "mappers")
+
     # -- ingestion ------------------------------------------------------
 
     def pending_rows(self) -> int:
@@ -495,6 +528,10 @@ class OnlineTrainer:
                 # serve→train→serve loop
                 "trace_id": telemetry.current_trace_id(),
                 "origin_trace_ids": sorted(self._window_traces),
+                # frozen-mapper fingerprint: the serving registry
+                # refuses a binned hot-swap whose .refbin sidecar does
+                # not hash to this (docs/serving.md "Binned inference")
+                "refbin_sha1": self._mapper_fp,
                 "published_unix": round(time.time(), 3), **stats}
         # write-ahead intent BEFORE anything touches publish_path: a
         # crash anywhere in the rename window is resolved on restart.

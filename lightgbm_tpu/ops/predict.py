@@ -22,7 +22,12 @@ Two kernels implement that walk, selected by ``predict_kernel``:
   (`predict_ensemble_binned`) walks the int bin store directly with
   in-bin thresholds (integer compares, no float thresholding), including
   the EFB packed-slot remap, so whole-model replay onto a ScoreUpdater
-  is `depth` passes instead of `len(trees)` sequential tree walks.
+  is `depth` passes instead of `len(trees)` sequential tree walks.  The
+  serving request path runs the same walk on ingress-quantized uint8
+  buffers (`predict_ensemble_quantized`, serve_quantize=binned): the
+  fixed-point traversal of the Booster accelerator applied end-to-end,
+  bitwise-identical to the raw kernel by construction of the quantizer
+  (lightgbm_tpu/quantize.py).
 """
 from __future__ import annotations
 
@@ -165,11 +170,18 @@ def ensemble_raw(stacks, X: jax.Array, *, depths) -> jax.Array:
 # packed node-record lane order of EnsembleStack.nodes (one trailing lane
 # axis so each depth level fetches ALL per-node fields with ONE gather of
 # a contiguous record, instead of five scattered gathers):
-#   raw stacks    (f32): feat, threshold, is_cat, left, right, default_left
-#   binned stacks (i32): feat, threshold_bin, decision, left, right, 0
+#   raw stacks    (f32):       feat, threshold, is_cat, left, right
+#   binned stacks (i16/i32):   feat, threshold_bin, decision, left, right
 # child ids / feature ids are exact in f32 (|v| < 2^24, num_leaves caps
-# far below that), so the raw record can stay one dtype.
-_LANES = 6
+# far below that), so the raw record can stay one dtype.  Binned stacks
+# narrow the whole record to int16 whenever every lane fits — half the
+# per-level record-gather bytes on the serving request path.  NaN/missing
+# routing needs no lane: raw kernels send NaN right (v <= t is False,
+# categorical finite mask matches nothing) and the binned request path
+# encodes missing as the quantizer's sentinel bin, which routes right
+# through the same integer compares (lightgbm_tpu/quantize.py) — the
+# never-populated default_left lane PR 7 reserved is gone.
+_LANES = 5
 
 
 class EnsembleStack(NamedTuple):
@@ -196,6 +208,12 @@ class PerfectEnsemble(NamedTuple):
     lanes, so the routing through filler slots is irrelevant (any path
     lands on the same value).  The LAST level's records fuse the two
     child leaf values in, saving the separate leaf-value gather.
+
+    BINNED perfect stacks (the serving request path under
+    serve_quantize=binned) carry the INNER feature id and the in-bin
+    threshold in the same f32 lanes: bin ids are < 2^24, so the f32
+    compare against a quantized buffer is exactly the integer compare
+    — one layout, both compare domains.
     """
     inner: jax.Array       # [T, 2^(D-1)-1, 2] f32: (feature, threshold)
     last: jax.Array        # [T, 2^(D-1), 4] f32: (feat, thr, lval, rval)
@@ -207,7 +225,6 @@ class EnsembleMeta(NamedTuple):
     depth: int             # levels to walk (max grown depth, >= 1)
     num_class: int         # K — rows of the [K, N] output
     any_cat: bool          # ensemble has categorical splits
-    any_default_left: bool  # any node routes NaN left (raw stacks only)
 
 
 # perfect relayout budget: total value-slab slots (T * 2^depth) above
@@ -219,17 +236,15 @@ PERFECT_SLOT_BUDGET = 1 << 22
 
 
 def _ensemble_shape(flat, binned: bool):
-    """(max-capacity leaves, walk depth, any_cat, any_dl) over a
-    class-major [(class, tree)] flatten — the ONE scan shared by
-    `build_ensemble`'s layout choice and `stack_ensemble`'s meta, so
-    the two can't desynchronize.  Binned stacks compare on
-    `binned_decision_type` (trivial-feature categorical splits rebin to
-    numerical sentinels) and never carry the NaN default-left lane
-    (binned replay routes missing rows by bin code)."""
+    """(max-capacity leaves, walk depth, any_cat) over a class-major
+    [(class, tree)] flatten — the ONE scan shared by `build_ensemble`'s
+    layout choice and `stack_ensemble`'s meta, so the two can't
+    desynchronize.  Binned stacks compare on `binned_decision_type`
+    (trivial-feature categorical splits rebin to numerical
+    sentinels)."""
     m = max(max(t.max_leaves for _, t in flat), 2)
     depth = 1
     any_cat = False
-    any_dl = False
     for _, t in flat:
         if t.num_leaves < 2:
             continue
@@ -238,10 +253,7 @@ def _ensemble_shape(flat, binned: bool):
         dec = (getattr(t, "binned_decision_type", t.decision_type)
                if binned else t.decision_type)
         any_cat = any_cat or bool(np.any(dec[:k] == 1))
-        if not binned:
-            dl = getattr(t, "default_left", None)
-            any_dl = any_dl or (dl is not None and bool(np.any(dl[:k])))
-    return m, max(int(depth), 1), any_cat, any_dl
+    return m, max(int(depth), 1), any_cat
 
 
 def build_ensemble(trees_by_class, *, binned: bool = False,
@@ -249,34 +261,32 @@ def build_ensemble(trees_by_class, *, binned: bool = False,
     """Build the tensorized-traversal stack for a whole model.
 
     Returns ``(stack, meta)`` where stack is a PerfectEnsemble (shallow,
-    purely numerical, no default-left raw ensembles within
-    PERFECT_SLOT_BUDGET) or the general EnsembleStack SoA — both host
-    numpy pytrees; callers `jax.device_put` them (per replica for the
-    serving fleet).  `predict_ensemble_any` dispatches on the type.
+    purely numerical raw ensembles within PERFECT_SLOT_BUDGET) or the
+    general EnsembleStack SoA — both host numpy pytrees; callers
+    `jax.device_put` them (per replica for the serving fleet).
+    `predict_ensemble_any` dispatches on the type.
     """
     num_class = len(trees_by_class)
     flat = [(k, t) for k, trees in enumerate(trees_by_class) for t in trees]
     if not flat:
         raise ValueError("build_ensemble needs at least one tree")
     shape = _ensemble_shape(flat, binned)
-    m, depth, any_cat, any_dl = shape
-    meta = EnsembleMeta(depth=depth, num_class=num_class, any_cat=any_cat,
-                        any_default_left=any_dl)
+    m, depth, any_cat = shape
+    meta = EnsembleMeta(depth=depth, num_class=num_class, any_cat=any_cat)
     if layout not in ("auto", "perfect", "soa"):
         raise ValueError(f"unknown ensemble layout: {layout!r}")
     if layout == "auto":
         fits = len(flat) << depth <= PERFECT_SLOT_BUDGET
-        layout = ("perfect" if fits and not binned and not any_cat
-                  and not any_dl else "soa")
+        layout = "perfect" if fits and not any_cat else "soa"
     if layout == "perfect":
-        if binned or any_cat or any_dl:
-            raise ValueError("perfect layout supports raw numerical "
-                             "no-default-left ensembles only")
-        return _build_perfect(flat, meta)
+        if any_cat:
+            raise ValueError("perfect layout supports numerical "
+                             "ensembles only")
+        return _build_perfect(flat, meta, binned=binned)
     return stack_ensemble(trees_by_class, binned=binned, _shape=shape)
 
 
-def _build_perfect(flat, meta: EnsembleMeta
+def _build_perfect(flat, meta: EnsembleMeta, binned: bool = False
                    ) -> tuple[PerfectEnsemble, EnsembleMeta]:
     D = meta.depth
     T = len(flat)
@@ -286,6 +296,10 @@ def _build_perfect(flat, meta: EnsembleMeta
     cls = np.zeros(T, np.int32)
     for i, (k, t) in enumerate(flat):
         cls[i] = k
+        # binned stacks speak (inner feature, in-bin threshold) — both
+        # < 2^24, exact in the f32 lanes
+        sf = t.split_feature_inner if binned else t.split_feature
+        th = t.threshold_in_bin if binned else t.threshold
         if t.num_leaves < 2:                 # stump: one giant filler
             last[i, :, 2] = last[i, :, 3] = np.float32(t.leaf_value[0])
             continue
@@ -303,8 +317,8 @@ def _build_perfect(flat, meta: EnsembleMeta
                     lc = int(t.left_child[node])
                     rc = int(t.right_child[node])
                     # children at depth D of a depth-D tree are leaves
-                    last[i, local, 0] = t.split_feature[node]
-                    last[i, local, 1] = np.float32(t.threshold[node])
+                    last[i, local, 0] = sf[node]
+                    last[i, local, 1] = np.float32(th[node])
                     last[i, local, 2] = np.float32(t.leaf_value[~lc])
                     last[i, local, 3] = np.float32(t.leaf_value[~rc])
                 continue
@@ -314,8 +328,8 @@ def _build_perfect(flat, meta: EnsembleMeta
                 v = np.float32(t.leaf_value[~node])
                 last[i, lo:hi, 2] = last[i, lo:hi, 3] = v
                 continue
-            inner[i, slot, 0] = t.split_feature[node]
-            inner[i, slot, 1] = np.float32(t.threshold[node])
+            inner[i, slot, 0] = sf[node]
+            inner[i, slot, 1] = np.float32(th[node])
             stack.append((int(t.left_child[node]), 2 * slot + 1, lvl + 1))
             stack.append((int(t.right_child[node]), 2 * slot + 2, lvl + 1))
     return PerfectEnsemble(inner=inner, last=last, class_id=cls), meta
@@ -334,9 +348,8 @@ def stack_ensemble(trees_by_class, *, binned: bool, _shape=None
     flat = [(k, t) for k, trees in enumerate(trees_by_class) for t in trees]
     if not flat:
         raise ValueError("stack_ensemble needs at least one tree")
-    m, depth, any_cat, any_dl = _shape or _ensemble_shape(flat, binned)
-    meta = EnsembleMeta(depth=depth, num_class=num_class, any_cat=any_cat,
-                        any_default_left=any_dl)
+    m, depth, any_cat = _shape or _ensemble_shape(flat, binned)
+    meta = EnsembleMeta(depth=depth, num_class=num_class, any_cat=any_cat)
     T = len(flat)
     dtype = np.int32 if binned else np.float32
     nodes = np.zeros((T, m - 1, _LANES), dtype)
@@ -360,11 +373,17 @@ def stack_ensemble(trees_by_class, *, binned: bool, _shape=None
             nodes[i, :knodes, 0] = t.split_feature[:knodes]
             nodes[i, :knodes, 1] = t.threshold[:knodes].astype(np.float32)
             nodes[i, :knodes, 2] = t.decision_type[:knodes]
-            dl = getattr(t, "default_left", None)
-            if dl is not None:
-                nodes[i, :knodes, 5] = np.asarray(dl[:knodes], dtype)
         nodes[i, :knodes, 3] = t.left_child[:knodes]
         nodes[i, :knodes, 4] = t.right_child[:knodes]
+    if binned and nodes.size and jax.default_backend() == "tpu" and \
+            -0x8000 <= int(nodes.min()) and int(nodes.max()) < 0x8000:
+        # the integer record narrows to int16 whenever every lane fits
+        # (bins < 2^15, children/features < 2^15 — always, outside the
+        # trivial-feature rebin sentinels): half the record-gather
+        # bytes per depth level on the binned serving request path.
+        # TPU only — CPU XLA's int16 gathers de-vectorize (measured
+        # 1.5x slower than the int32 record at the north-star shape)
+        nodes = nodes.astype(np.int16)
     stack = EnsembleStack(nodes=nodes, leaf_value=lv, root=root,
                           class_id=cls)
     return stack, meta
@@ -397,10 +416,7 @@ def predict_ensemble(stack: EnsembleStack, X: jax.Array, *,
 
     Decision parity with `_walk_one_tree` is bitwise: numerical ``v <=
     t`` (NaN falls right), categorical int-truncation compare behind
-    the host walk's finite mask (non-finite never matches).  Nodes
-    with the default-left lane set route NaN/non-finite values LEFT on
-    numerical splits (missing-value support; nothing sets it today, so
-    the select is compiled out unless the stack carries one).
+    the host walk's finite mask (non-finite never matches).
     """
     Xf = X.astype(jnp.float32)
     T = stack.nodes.shape[0]
@@ -415,8 +431,6 @@ def predict_ensemble(stack: EnsembleStack, X: jax.Array, *,
         v = Xf[rows, f]                                  # [T, N]
         t = rec[..., 1]
         gl = v <= t
-        if meta.any_default_left:
-            gl = jnp.where(jnp.isnan(v), rec[..., 5] > 0, gl)
         if meta.any_cat:
             # categorical: int truncation compare with the host walk's
             # explicit finite mask (tree.py predict_leaf_index), same
@@ -489,17 +503,20 @@ def predict_ensemble_any(stack, X: jax.Array, *,
     return predict_ensemble(stack, X, meta=meta)
 
 
-def _walk_binned_nodes(stack: EnsembleStack, bins_t: jax.Array,
+def _walk_binned_nodes(stack: EnsembleStack, bins_nt: jax.Array,
                        feat_tbl: Optional[jax.Array], meta: EnsembleMeta
                        ) -> jax.Array:
     """The binned ensemble walk itself: parked node per (tree, row) —
-    [T, N] int32, leaves encoded as ~leaf.  Shared by the score replay
-    (`predict_ensemble_binned`) and the leaf-index router
-    (`predict_ensemble_leaf_binned`) so the two can never disagree on a
-    routing decision — the online refit subsystem depends on routing
-    rows to exactly the leaves whose values the replay sums."""
-    N = bins_t.shape[0] - 1
-    bins_nt = bins_t[:N].astype(jnp.int32)
+    [T, N] int32, leaves encoded as ~leaf, over [N, C] integer bins.
+    Shared by the score replay (`predict_ensemble_binned`), the
+    leaf-index router (`predict_ensemble_leaf_binned`), and the serving
+    request path (`predict_ensemble_quantized`) so the three can never
+    disagree on a routing decision — the online refit subsystem depends
+    on routing rows to exactly the leaves whose values the replay sums,
+    and serving depends on integer compares reproducing the raw f32
+    kernel bit-for-bit (lightgbm_tpu/quantize.py)."""
+    N = bins_nt.shape[0]
+    bins_nt = bins_nt.astype(jnp.int32)
     T = stack.nodes.shape[0]
     rows = jnp.arange(N)[None, :]
     node = jnp.broadcast_to(stack.root[:, None], (T, N))
@@ -508,8 +525,8 @@ def _walk_binned_nodes(stack: EnsembleStack, bins_t: jax.Array,
     def step(_, node):
         safe = jnp.maximum(node, 0)
         rec = jnp.take_along_axis(stack.nodes, safe[:, :, None], axis=1)
-        f = rec[..., 0]
-        t = rec[..., 1]
+        f = rec[..., 0].astype(jnp.int32)
+        t = rec[..., 1].astype(jnp.int32)
         if ft is None:
             bv = bins_nt[rows, f]
         else:
@@ -527,7 +544,7 @@ def _walk_binned_nodes(stack: EnsembleStack, bins_t: jax.Array,
             gl = jnp.where(rec[..., 2] == 1, bv == t, bv <= t)
         else:
             gl = bv <= t
-        nxt = jnp.where(gl, rec[..., 3], rec[..., 4])
+        nxt = jnp.where(gl, rec[..., 3], rec[..., 4]).astype(jnp.int32)
         return jnp.where(node >= 0, nxt, node)
 
     return jax.lax.fori_loop(0, meta.depth, step, node)
@@ -547,7 +564,40 @@ def predict_ensemble_binned(stack: EnsembleStack, bins_t: jax.Array,
     score_updater._walk_step: trees speak original (feature, bin) space,
     the store speaks bundle space.
     """
-    node = _walk_binned_nodes(stack, bins_t, feat_tbl, meta)
+    node = _walk_binned_nodes(stack, bins_t[: bins_t.shape[0] - 1],
+                              feat_tbl, meta)
+    return _leaf_sums(stack, node, meta.num_class)
+
+
+def predict_ensemble_quantized(stack, Xb: jax.Array, *,
+                               meta: EnsembleMeta) -> jax.Array:
+    """Raw per-class scores over an ingress-quantized request buffer —
+    [K, N] f32 from [N, F] uint8/uint16 ORIGINAL per-feature bin ids
+    (quantize.FeatureQuantizer) — the binned serving request path.
+
+    Layout dispatch mirrors the raw path: shallow numerical ensembles
+    traverse the PERFECT layout (arithmetic navigation; the f32 lanes
+    carry bin ids < 2^24, so the compare is exactly the integer
+    compare), everything else runs the SoA walk shared with the
+    replay/router (`_walk_binned_nodes`) with integer compares end to
+    end.  Either way the per-request buffer ships to the device 4x
+    smaller than f32, and the quantizer's MISSING sentinel exceeds
+    every threshold bin and matches no category bin, so
+    NaN/unseen-category rows route exactly like the raw kernel (always
+    right); scores are bitwise the raw-feature kernel's on every
+    input.  No ``feat_tbl``: trees speak original (feature, bin) space
+    and the ingress buffer is built in it — EFB remaps are a
+    training-store concern.
+    """
+    if isinstance(stack, PerfectEnsemble):
+        return predict_ensemble_perfect(stack, Xb, meta=meta)
+    return _predict_ensemble_quantized_soa(stack, Xb, meta=meta)
+
+
+@functools.partial(jax.jit, static_argnames=("meta",))
+def _predict_ensemble_quantized_soa(stack: EnsembleStack, Xb: jax.Array,
+                                    *, meta: EnsembleMeta) -> jax.Array:
+    node = _walk_binned_nodes(stack, Xb, None, meta)
     return _leaf_sums(stack, node, meta.num_class)
 
 
@@ -562,7 +612,8 @@ def predict_ensemble_leaf_binned(stack: EnsembleStack, bins_t: jax.Array,
     leaf 0).  Integer bin compares end to end, so routing is exact on
     any store the trees were rebinned to.
     """
-    node = _walk_binned_nodes(stack, bins_t, feat_tbl, meta)
+    node = _walk_binned_nodes(stack, bins_t[: bins_t.shape[0] - 1],
+                              feat_tbl, meta)
     return jnp.where(node < 0, ~node, 0)
 
 
@@ -592,8 +643,6 @@ def predict_ensemble_leaf(stack: EnsembleStack, X: jax.Array, *,
         v = Xf[rows, f]                                  # [T, N]
         t = rec[..., 1]
         gl = v <= t
-        if meta.any_default_left:
-            gl = jnp.where(jnp.isnan(v), rec[..., 5] > 0, gl)
         if meta.any_cat:
             finite = jnp.isfinite(v)
             vi = jnp.where(finite, v, -1.0).astype(jnp.int32)

@@ -86,6 +86,18 @@ SERVE_REPLICA_BROKEN = "serve.replica_broken"
 SERVE_REPLICA_READMITTED = "serve.replica_readmitted"
 SERVE_REPLICA_PROBES = "serve.replica_probes"
 
+# Canonical binned-inference counters (docs/serving.md "Binned
+# inference"), fed through count() by the serving runtime's ingress
+# quantization (serve_quantize=binned):
+#  - SERVE_QUANTIZE_BYTES_IN: bytes of the quantized uint8/uint16
+#    request buffers shipped to the device — ~4x below what the same
+#    rows cost as f32, the memory-bandwidth win of fixed-point
+#    traversal.
+#  - SERVE_BINNED_REQUESTS: predict() calls that ran the binned kernel
+#    variant (raw-variant runtimes count nothing here).
+SERVE_QUANTIZE_BYTES_IN = "serve/quantize_bytes_in"
+SERVE_BINNED_REQUESTS = "serve/binned_requests"
+
 # Every canonical counter constant of this module, in one tuple: the
 # Prometheus exposition (telemetry.prometheus_text) seeds each of these
 # at 0 so a scrape always covers the full canonical set, and the
@@ -95,6 +107,7 @@ CANONICAL_COUNTERS = (
     HIST_ROWS_TOUCHED, HIST_EXCHANGE_BYTES, SPLIT_RECORDS_BYTES,
     REGISTRY_SWAP_FAILURES, SERVE_CHUNK_RETRIES, SERVE_REPLICA_FAILURES,
     SERVE_REPLICA_BROKEN, SERVE_REPLICA_READMITTED, SERVE_REPLICA_PROBES,
+    SERVE_QUANTIZE_BYTES_IN, SERVE_BINNED_REQUESTS,
 )
 
 

@@ -13,7 +13,13 @@ active (generation, PredictorRuntime) pair and swaps it atomically:
 - a model that fails to load or compile is rolled back: the old runtime
   keeps serving, the bad file signature is remembered so the poll loop
   does not retry-spin on it, and `registry/swap_failures` is counted
-  (exception class + message logged and kept as `last_swap_error`).
+  (exception class + message logged and kept as `last_swap_error`);
+- under ``serve_quantize=binned`` the model's ``.refbin`` frozen-mapper
+  sidecar is part of the swap: missing, torn, or sha1-mismatched (vs
+  the publish meta's ``refbin_sha1``) sidecars REFUSE the swap through
+  the same rollback path — the old generation keeps serving and the
+  failure is /stats-visible.  ``auto`` falls back to raw-feature
+  serving instead of refusing.
 
 Readers never lock: `current()` is one attribute read; in-flight batches
 that pinned the previous runtime finish on it untouched.
@@ -43,7 +49,9 @@ class ModelRegistry:
                  warmup_buckets: Sequence[int] = (1,),
                  warmup_kinds: Sequence[str] = OUTPUT_KINDS,
                  predict_kernel: Optional[str] = None, replicas: int = 0,
-                 failure_threshold: int = 3):
+                 failure_threshold: int = 3,
+                 serve_quantize: str = "auto"):
+        from ..config import SERVE_QUANTIZE_MODES
         self.model_path = model_path
         self.params = dict(params or {})
         self.num_iteration = num_iteration
@@ -55,6 +63,10 @@ class ModelRegistry:
         self.predict_kernel = predict_kernel
         self.replicas = replicas
         self.failure_threshold = failure_threshold
+        if serve_quantize not in SERVE_QUANTIZE_MODES:
+            raise ValueError(f"unknown serve_quantize: {serve_quantize!r};"
+                             f" use one of {SERVE_QUANTIZE_MODES}")
+        self.serve_quantize = serve_quantize
         self.last_swap_error: Optional[str] = None
         self._lock = threading.Lock()       # serializes WRITERS only
         self._failed_sig: Optional[Tuple[int, int]] = None
@@ -82,14 +94,43 @@ class ModelRegistry:
 
     def _load(self, generation: int) -> PredictorRuntime:
         from ..basic import Booster
+        from .runtime import resolve_runtime
         booster = Booster(model_file=self.model_path, params=self.params)
-        return PredictorRuntime(booster, num_iteration=self.num_iteration,
-                                max_batch_rows=self.max_batch_rows,
-                                min_bucket_rows=self.min_bucket_rows,
-                                generation=generation,
-                                predict_kernel=self.predict_kernel,
-                                replicas=self.replicas,
-                                failure_threshold=self.failure_threshold)
+        # binned serving: the model's .refbin frozen-mapper sidecar is
+        # loaded fresh at every swap (it may be republished with the
+        # model) and validated — sha1 against the publish meta inside
+        # _load_refbin, feature coverage / threshold representability
+        # inside the runtime build.  serve_quantize=binned makes ANY
+        # failure refuse the swap (maybe_reload keeps the old
+        # generation serving); =auto falls back to the raw kernel.
+        return resolve_runtime(
+            booster, serve_quantize=self.serve_quantize,
+            refbin=self._load_refbin,
+            num_iteration=self.num_iteration,
+            max_batch_rows=self.max_batch_rows,
+            min_bucket_rows=self.min_bucket_rows,
+            generation=generation,
+            predict_kernel=self.predict_kernel,
+            replicas=self.replicas,
+            failure_threshold=self.failure_threshold)
+
+    def _load_refbin(self):
+        """The model's ``.refbin`` sidecar, checked against the publish
+        meta's ``refbin_sha1`` fingerprint when the model was published
+        by the online trainer (offline models carry no meta — the
+        sidecar is then trusted on its own format/consistency checks).
+        NOTE: a swap refused over a torn sidecar is remembered by the
+        MODEL file's signature; republishing only the sidecar needs a
+        SIGHUP (or the next model publish) to retry."""
+        from ..quantize import load_refbin
+        expected = None
+        try:
+            with open(self.model_path + ".meta.json") as f:
+                expected = json.load(f).get("refbin_sha1")
+        except (OSError, ValueError):
+            expected = None
+        return load_refbin(self.model_path + ".refbin",
+                           expected_sha1=expected)
 
     def _publish_trace_id(self) -> Optional[str]:
         """The publishing refresh's trace id from the online trainer's

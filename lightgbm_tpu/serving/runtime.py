@@ -25,6 +25,12 @@ literature prescribes (arXiv:1806.11248 §5, arXiv:2011.02022):
   "heavy traffic from millions of users";
 - the per-request feature buffer is donated on accelerator backends, so
   XLA may reuse it for the output and skip one HBM round trip;
+- with ``serve_quantize=binned`` (quantize="binned" + a refbin mapper
+  set here), every chunk quantizes to uint8 bin ids at ingress and the
+  traversal compares integer bins end-to-end (ops/predict.py
+  predict_ensemble_quantized): the request buffer ships 4x smaller and
+  scores stay bit-identical to the raw kernel by construction
+  (lightgbm_tpu/quantize.py);
 - the sigmoid/softmax output transform runs inside the compiled program
   ("value" kind) — the host only sees finished predictions.
 
@@ -82,6 +88,45 @@ def row_bucket(n: int, min_bucket: int, max_bucket: int) -> int:
     return min(b, max_bucket)
 
 
+def resolve_runtime(booster, *, serve_quantize: str = "auto",
+                    refbin=None, **kw) -> "PredictorRuntime":
+    """Build a PredictorRuntime honoring the ``serve_quantize`` dial —
+    the ONE place the auto/binned/raw policy lives (ModelRegistry and
+    the CLI batch Predictor both route through here).
+
+    ``raw`` → raw-feature runtime.  ``binned`` → binned runtime; ANY
+    failure (missing/invalid sidecar, unrepresentable thresholds,
+    compile error) propagates.  ``auto`` → binned whenever the refbin
+    source yields a valid mapper set, raw otherwise (one log line says
+    why).  ``refbin`` may be a sidecar path, a Dataset, or a zero-arg
+    callable returning either — the registry defers its sha-validated
+    sidecar load into the try this way.
+    """
+    import os
+
+    from ..config import SERVE_QUANTIZE_MODES
+    if serve_quantize not in SERVE_QUANTIZE_MODES:
+        raise ValueError(f"unknown serve_quantize: {serve_quantize!r}; "
+                         f"use one of {SERVE_QUANTIZE_MODES}")
+    if serve_quantize != "raw":
+        try:
+            rb = refbin() if callable(refbin) else refbin
+            if rb is None:
+                raise LightGBMError(
+                    "no .refbin frozen-mapper sidecar (Dataset."
+                    "save_refbin, or an online-published model)")
+            if isinstance(rb, str) and not os.path.exists(rb):
+                raise LightGBMError(f"no .refbin sidecar at {rb}")
+            return PredictorRuntime(booster, quantize="binned",
+                                    refbin=rb, **kw)
+        except Exception as e:
+            if serve_quantize == "binned":
+                raise
+            log.info("serve_quantize=auto: serving raw features "
+                     f"({type(e).__name__}: {e})")
+    return PredictorRuntime(booster, **kw)
+
+
 def resolve_serve_replicas(replicas: int = 0) -> list:
     """The local devices a serving fleet replicates onto.
 
@@ -134,7 +179,8 @@ class PredictorRuntime:
                  max_batch_rows: int = 4096, min_bucket_rows: int = 16,
                  generation: int = 0, predict_kernel: Optional[str] = None,
                  replicas: int = 0, failure_threshold: int = 3,
-                 probe_after: Optional[int] = None):
+                 probe_after: Optional[int] = None,
+                 quantize: str = "raw", refbin=None):
         import jax
         from ..ops.predict import resolve_predict_kernel
 
@@ -159,7 +205,47 @@ class PredictorRuntime:
                                      "predict_kernel", "auto")
         self.predict_kernel = resolve_predict_kernel(predict_kernel)
         used = gbdt._num_used_models(num_iteration)
+        # request-path quantization (docs/serving.md "Binned inference"):
+        # "binned" rebins the model against the frozen refbin mapper set,
+        # quantizes every chunk at ingress, and traverses integer bins
+        # end-to-end — bit-identical scores, 4x smaller request buffer
+        if quantize not in ("raw", "binned"):
+            raise ValueError("PredictorRuntime quantize must be 'raw' or "
+                             f"'binned', got {quantize!r} (the auto "
+                             "resolution happens in resolve_runtime)")
+        self._quantizer = None
+        self.variant = quantize
+        if quantize == "binned":
+            from ..quantize import (FeatureQuantizer, load_refbin,
+                                    rebin_models_for_serving)
+            if refbin is None:
+                raise LightGBMError(
+                    "binned serving needs a refbin mapper set (a .refbin "
+                    "sidecar path or a Dataset)")
+            if isinstance(refbin, str):
+                refbin = load_refbin(refbin)
+            if refbin.num_total_features != self.num_features:
+                raise LightGBMError(
+                    f"refbin mapper set covers "
+                    f"{refbin.num_total_features} features, the model "
+                    f"expects {self.num_features}")
+            rebin_models_for_serving(gbdt.models[:used], refbin)
+            self._quantizer = FeatureQuantizer(refbin.mappers,
+                                               refbin.used_features)
+            if self.predict_kernel != "tensorized":
+                log.info("serve_quantize=binned traverses the tensorized "
+                         "binned stack; the predict_kernel="
+                         f"{self.predict_kernel} dial applies to raw "
+                         "serving only")
         host_stacks = self._build_host_stacks(gbdt, used)
+        # the per-chunk device buffer: quantized uint8/uint16 bins over
+        # the used features, or the raw f32 feature matrix
+        if self._quantizer is not None:
+            self._buf_dtype = self._quantizer.dtype
+            self._buf_cols = self._quantizer.num_columns
+        else:
+            self._buf_dtype = np.float32
+            self._buf_cols = self.num_features
         self._device_value = self._device_value_fn()
         # X is donated only where donation is real; on CPU it would just
         # print an "unusable donated buffer" warning per call
@@ -220,6 +306,12 @@ class PredictorRuntime:
     def _build_host_stacks(self, gbdt, used: int):
         """Host-numpy ensemble stacks — device_put once per replica.
 
+        binned (serve_quantize=binned): ONE stack over every class with
+        thresholds in bin space — the PERFECT layout for shallow
+        numerical ensembles (bin ids exact in the f32 lanes), the
+        integer-record SoA (int16 lanes on TPU) otherwise; the same
+        layout-auto predicate as the raw path, so the two variants
+        always make the same layout choice for a given model.
         tensorized: ONE stack over every class (`self._meta` static).
         walk: one TreeStack per class (None for a never-trained class,
         its raw row stays 0 like GBDT._predict_raw_device).
@@ -228,6 +320,10 @@ class PredictorRuntime:
         trees_by_class = [
             [gbdt.models[i] for i in range(used) if i % self.K == k]
             for k in range(self.K)]
+        if self.variant == "binned":
+            stack, meta = build_ensemble(trees_by_class, binned=True)
+            self._meta = meta
+            return stack
         if self.predict_kernel == "tensorized":
             stack, meta = build_ensemble(trees_by_class, binned=False)
             self._meta = meta
@@ -279,6 +375,13 @@ class PredictorRuntime:
 
     def _raw_fn(self):
         """The traced ensemble-traversal body, (stacks, X) -> [K, rows]."""
+        if self.variant == "binned":
+            from ..ops.predict import predict_ensemble_quantized
+            meta = self._meta
+
+            def fn(stacks, Xb):
+                return predict_ensemble_quantized(stacks, Xb, meta=meta)
+            return fn
         if self.predict_kernel == "tensorized":
             from ..ops.predict import predict_ensemble_any
             meta = self._meta
@@ -312,7 +415,7 @@ class PredictorRuntime:
 
         donate = (1,) if self._donate else ()
         x_spec = jax.ShapeDtypeStruct(
-            (bucket, self.num_features), jnp.float32,
+            (bucket, self._buf_cols), jnp.dtype(self._buf_dtype),
             sharding=SingleDeviceSharding(replica.device))
         t0 = time.perf_counter()
         compiled = (jax.jit(fn, donate_argnums=donate)
@@ -324,7 +427,10 @@ class PredictorRuntime:
         return compiled
 
     def _get_executable(self, replica: _Replica, bucket: int, kind: str):
-        key = (bucket, kind)
+        # the kernel VARIANT is part of the key: a binned and a raw
+        # executable at the same (bucket, kind) are different programs
+        # over different buffer dtypes and must never collide
+        key = (bucket, kind, self.variant)
         with self._lock:
             exe = replica.compiled.get(key)
             if exe is not None:
@@ -343,11 +449,13 @@ class PredictorRuntime:
     # -- introspection --------------------------------------------------
 
     def buckets_compiled(self) -> List[Tuple[int, str]]:
-        """Distinct (bucket, kind) pairs compiled on ANY replica."""
+        """Distinct (bucket, kind) pairs compiled on ANY replica (the
+        kernel variant is uniform per runtime and elided — swap warmup
+        carries buckets across variants)."""
         with self._lock:
             keys = set()
             for r in self.replicas:
-                keys.update(r.compiled)
+                keys.update((b, k) for b, k, _v in r.compiled)
             return sorted(keys)
 
     def warmup(self, buckets: Sequence[int] = (),
@@ -366,7 +474,9 @@ class PredictorRuntime:
         for replica in self.replicas:
             for b in buckets:
                 for kind in run_kinds:
-                    zeros = np.zeros((b, self.num_features), np.float32)
+                    # bin 0 is a valid bin everywhere, so the all-zeros
+                    # buffer warms the binned variant too
+                    zeros = np.zeros((b, self._buf_cols), self._buf_dtype)
                     self._run_compiled(b, kind, zeros, replica=replica)
 
     # -- prediction -----------------------------------------------------
@@ -473,6 +583,7 @@ class PredictorRuntime:
             # this chunk, at which bucket/kind, under which generation
             with telemetry.span("serve.replica", replica=replica.index,
                                 bucket=bucket, kind=kind,
+                                variant=self.variant,
                                 generation=self.generation):
                 # chaos seams: a dispatch raising (any replica / THIS
                 # replica) is the circuit breaker's trigger condition
@@ -485,7 +596,7 @@ class PredictorRuntime:
                 # conversions here would be one h2d + one d2h violation
                 # per request
                 out = exe(replica.stacks,
-                          jax.device_put(Xpad.astype(np.float32,
+                          jax.device_put(Xpad.astype(self._buf_dtype,
                                                      copy=False),
                                          replica.device))
                 res = jax.device_get(out).astype(np.float64)  # [K, bucket]
@@ -502,9 +613,17 @@ class PredictorRuntime:
                 replica.inflight -= 1
 
     def _predict_chunk(self, X: np.ndarray, kind: str) -> np.ndarray:
+        if self._quantizer is not None:
+            # ingress quantization: raw f64 rows → uint8/uint16 original
+            # per-feature bins, host-side (numpy — thread-safe under the
+            # chunk fan-out pool).  The device buffer shrinks 4x vs f32,
+            # which is the bytes/row the canonical counter tracks.
+            X = self._quantizer.quantize(X)
+            profiling.count(profiling.SERVE_QUANTIZE_BYTES_IN, X.nbytes)
         n = X.shape[0]
         bucket = row_bucket(n, self.min_bucket_rows, self.max_batch_rows)
         if n < bucket:
+            # pad rows carry bin 0 / feature 0.0 — sliced off below
             X = np.pad(X, ((0, bucket - n), (0, 0)))
         try:
             out = self._run_compiled(bucket, kind, X)
@@ -560,6 +679,8 @@ class PredictorRuntime:
         if n == 0:
             return (np.zeros(0) if self.K == 1
                     else np.zeros((0, self.K)))
+        if self._quantizer is not None:
+            profiling.count(profiling.SERVE_BINNED_REQUESTS)
         run_kind = self._run_kind(kind)
         starts = range(0, n, self.max_batch_rows)
         with profiling.phase("serve/execute", force=True):

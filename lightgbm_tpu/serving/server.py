@@ -328,7 +328,14 @@ class PredictionServer:
                     profiling.SERVE_REPLICA_READMITTED),
                 "predict_kernel": getattr(runtime, "predict_kernel",
                                           "walk"),
+                # the request-path kernel variant actually in service
+                # ("binned" = ingress quantization + integer traversal)
+                "serve_quantize": getattr(runtime, "variant", "raw"),
             },
+            "quantize_bytes_in": profiling.counter_value(
+                profiling.SERVE_QUANTIZE_BYTES_IN),
+            "binned_requests": profiling.counter_value(
+                profiling.SERVE_BINNED_REQUESTS),
             "batch_workers": self.batcher.workers,
             "rejected": self.batcher.rejected,
             "timeouts": profiling.counter_value("serve.timeouts"),
@@ -391,7 +398,8 @@ def server_from_config(cfg: Config) -> PredictionServer:
         min_bucket_rows=cfg.min_bucket_rows,
         predict_kernel=cfg.predict_kernel,
         replicas=cfg.serve_replicas,
-        failure_threshold=cfg.replica_failure_threshold)
+        failure_threshold=cfg.replica_failure_threshold,
+        serve_quantize=cfg.serve_quantize)
     return PredictionServer(
         registry, host=cfg.serve_host, port=cfg.serve_port,
         max_batch_rows=cfg.max_batch_rows,

@@ -1,30 +1,39 @@
-"""Serving-fleet bench — sustained-QPS load + predict-kernel A/B.
+"""Serving-fleet bench — sustained-QPS load + kernel and quantize A/Bs.
 
 Prints ONE JSON line (same shape as bench.py) and optionally writes it
-to ``SERVE_BENCH_OUT``.  Three sections:
+to ``SERVE_BENCH_OUT``.  Four sections:
 
 1. **Kernel A/B** — `predict_kernel=walk` vs `tensorized` through the
    same PredictorRuntime at the north-star model shape (500 trees,
    depth <= 8 by default): interleaved calls, min-call-time rows/s per
    kernel (median alongside) and the speedup.
-2. **Sustained load** — the full serving stack (ModelRegistry →
+2. **Quantize A/B** — `serve_quantize=raw` vs `binned` through the
+   same runtime class at the same shape: interleaved calls including
+   the binned side's host ingress quantization, min-call-time rows/s,
+   speedup, and the request-buffer byte ratio (f32 vs uint8 — the >=4x
+   shrink the binned path ships to the device).  Answers are asserted
+   BITWISE equal before timing.
+3. **Sustained load** — the full serving stack (ModelRegistry →
    continuous MicroBatcher → replicated PredictorRuntime → HTTP) under
    `SERVE_BENCH_CLIENTS` concurrent clients for `SERVE_BENCH_SECONDS`
-   (paced to `SERVE_BENCH_QPS` aggregate when set, closed-loop
-   otherwise): p50/p95/p99 request latency, achieved QPS, sustained
-   rows/s, replica count and per-replica dispatch balance.
-3. **Sanitize** (`BENCH_SANITIZE=1`) — the PredictorRuntime hot path
-   probed directly under `HotPathSanitizer` (single-threaded — jax's
-   transfer guard is thread-local, so the HTTP stack's flush threads
-   can't be guarded from here) at steady state: ZERO retraces and ZERO
-   implicit transfers per request after warmup, asserted AFTER the JSON
-   line prints so the chip-queue log always has the counter evidence.
+   per side (paced to `SERVE_BENCH_QPS` aggregate when set, closed-loop
+   otherwise), run TWICE — serve_quantize=raw then =binned against the
+   same published model + .refbin sidecar: p50/p95/p99 request latency,
+   achieved QPS, sustained rows/s, replica dispatch balance per side.
+4. **Sanitize** (`BENCH_SANITIZE=1`) — BOTH runtime variants probed
+   directly under `HotPathSanitizer` (single-threaded — jax's transfer
+   guard is thread-local, so the HTTP stack's flush threads can't be
+   guarded from here) at steady state: ZERO retraces and ZERO implicit
+   transfers per request after warmup, asserted AFTER the JSON line
+   prints so the chip-queue log always has the counter evidence.
 
 Env knobs: SERVE_BENCH_TREES (500), SERVE_BENCH_LEAVES (63),
 SERVE_BENCH_DEPTH (8), SERVE_BENCH_ROWS (rows/request, 64),
-SERVE_BENCH_CLIENTS (8), SERVE_BENCH_SECONDS (10), SERVE_BENCH_QPS
-(0 = closed loop), SERVE_BENCH_REPLICAS (0 = auto),
-SERVE_BENCH_AB_ROWS (2048), SERVE_BENCH_AB_REPS (15), SERVE_BENCH_OUT.
+SERVE_BENCH_CLIENTS (8), SERVE_BENCH_SECONDS (10, per sustained side),
+SERVE_BENCH_QPS (0 = closed loop), SERVE_BENCH_REPLICAS (0 = auto),
+SERVE_BENCH_AB_ROWS (2048), SERVE_BENCH_AB_REPS (15), SERVE_BENCH_OUT,
+SERVE_BENCH_REQUIRE_SPEEDUP (kernel A/B gate),
+SERVE_BENCH_REQUIRE_BINNED (fail if binned rows/s < raw * this).
 """
 import json
 import os
@@ -52,16 +61,25 @@ AB_REPS = int(os.environ.get("SERVE_BENCH_AB_REPS", 15))
 FEATURES = 28
 
 
+_PARAMS = {"objective": "binary", "verbose": -1, "num_leaves": 0,
+           "max_depth": 0, "min_data_in_leaf": 20}
+
+
 def _train_model():
     """Synthetic HIGGS-shaped binary model at the north-star serving
-    shape.  ``SERVE_BENCH_MODEL=<path>`` caches the trained model text
-    across runs (training 500 trees dwarfs the measured phases on the
-    CPU tier); the feature matrix is regenerated deterministically."""
+    shape, plus the frozen-mapper refbin dataset the binned serving
+    path quantizes against.  ``SERVE_BENCH_MODEL=<path>`` caches the
+    trained model text across runs (training 500 trees dwarfs the
+    measured phases on the CPU tier); the feature matrix — and with it
+    the deterministic bin mappers — is regenerated either way, so the
+    refbin always matches the model's training quantization."""
     import lightgbm_tpu as lgb
+    params = dict(_PARAMS, num_leaves=LEAVES, max_depth=DEPTH)
     rng = np.random.RandomState(0)
     X = rng.rand(20_000, FEATURES)
     z = X @ rng.randn(FEATURES)
     y = (z > np.median(z)).astype(float)
+    ds = lgb.Dataset(X, y)
     cache = os.environ.get("SERVE_BENCH_MODEL", "")
     shape = {"trees": TREES, "leaves": LEAVES, "depth": DEPTH}
     if cache and os.path.exists(cache):
@@ -75,17 +93,16 @@ def _train_model():
         except (OSError, ValueError):
             cached_shape = None
         if cached_shape == shape:
-            return lgb.Booster(model_file=cache), X
-    bst = lgb.Booster({"objective": "binary", "verbose": -1,
-                       "num_leaves": LEAVES, "max_depth": DEPTH,
-                       "min_data_in_leaf": 20}, lgb.Dataset(X, y))
+            ds.construct(params)          # mappers only (deterministic)
+            return lgb.Booster(model_file=cache), X, ds._inner
+    bst = lgb.Booster(params, ds)
     for _ in range(TREES):
         bst.update()
     if cache:
         bst.save_model(cache)
         with open(cache + ".meta", "w") as f:
             json.dump(shape, f)
-    return bst, X
+    return bst, X, ds.construct()._inner
 
 
 def _kernel_ab(bst, X):
@@ -121,6 +138,50 @@ def _kernel_ab(bst, X):
                        "rows_per_s": round(AB_ROWS / best, 1)}
     out["speedup"] = round(out["tensorized"]["rows_per_s"]
                            / out["walk"]["rows_per_s"], 3)
+    return out
+
+
+def _quantize_ab(bst, X, refbin):
+    """serve_quantize=raw vs binned throughput through the runtime,
+    same bucket, same rows, interleaved min-call-time (the kernel-A/B
+    measurement discipline).  The binned side pays its real ingress
+    cost (host quantization) inside the timed call.  Scores are
+    asserted BITWISE equal before any timing — the acceptance bar of
+    the binned path."""
+    from lightgbm_tpu.serving import PredictorRuntime
+    Xq = np.ascontiguousarray(X[:AB_ROWS], np.float64)
+    rts = {
+        "raw": PredictorRuntime(bst, replicas=1, max_batch_rows=AB_ROWS,
+                                min_bucket_rows=AB_ROWS),
+        "binned": PredictorRuntime(bst, replicas=1, quantize="binned",
+                                   refbin=refbin, max_batch_rows=AB_ROWS,
+                                   min_bucket_rows=AB_ROWS),
+    }
+    base = rts["raw"].predict(Xq)                   # compile + warm
+    got = rts["binned"].predict(Xq)
+    if not np.array_equal(base, got):
+        raise SystemExit("raw-vs-binned parity FAILED at the bench shape")
+    times = {k: [] for k in rts}
+    for _ in range(AB_REPS):
+        for variant, rt in rts.items():
+            t0 = time.perf_counter()
+            rt.predict(Xq)
+            times[variant].append(time.perf_counter() - t0)
+    rb = rts["binned"]
+    out = {"rows": AB_ROWS, "reps": AB_REPS, "bitwise_equal": True,
+           "buffer_bytes_raw": AB_ROWS * rb.num_features * 4,
+           "buffer_bytes_binned": int(
+               AB_ROWS * rb._buf_cols * np.dtype(rb._buf_dtype).itemsize)}
+    out["buffer_shrink"] = round(out["buffer_bytes_raw"]
+                                 / out["buffer_bytes_binned"], 2)
+    for variant in rts:
+        best = min(times[variant])
+        med = sorted(times[variant])[AB_REPS // 2]
+        out[variant] = {"ms_per_call": round(best * 1e3, 3),
+                        "ms_per_call_median": round(med * 1e3, 3),
+                        "rows_per_s": round(AB_ROWS / best, 1)}
+    out["speedup"] = round(out["binned"]["rows_per_s"]
+                           / out["raw"]["rows_per_s"], 3)
     return out
 
 
@@ -206,18 +267,22 @@ def main() -> None:
     from lightgbm_tpu.serving import ModelRegistry, PredictionServer
 
     t_train0 = time.monotonic()
-    bst, X = _train_model()
+    bst, X, refbin = _train_model()
     train_s = time.monotonic() - t_train0
     depth_grown = max((t.max_depth_grown
                        for t in bst._gbdt.models if t.num_leaves > 1),
                       default=0)
     ab = _kernel_ab(bst, X)
+    qab = _quantize_ab(bst, X, refbin)
 
-    san = None
-    san_rec = None
+    sans = []
+    san_rec = {}
+    loads = {}
+    stats = {}
     with tempfile.TemporaryDirectory() as tmp:
         model_path = os.path.join(tmp, "model.txt")
         bst.save_model(model_path)
+        refbin.save_refbin(model_path + ".refbin")
         # warm every bucket a coalesced batch can land on (1 request up
         # to all clients' requests in one flush)
         warm = []
@@ -225,46 +290,68 @@ def main() -> None:
         while b <= min(CLIENTS * ROWS_PER_REQ, 4096):
             warm.append(b)
             b <<= 1
-        registry = ModelRegistry(model_path, params={"verbose": -1},
-                                 max_batch_rows=4096,
-                                 warmup_buckets=tuple(warm) or (ROWS_PER_REQ,),
-                                 replicas=REPLICAS)
-        runtime = registry.current()
-        if sanitize_enabled():
-            Xq = np.ascontiguousarray(X[:ROWS_PER_REQ], np.float64)
-            san = HotPathSanitizer(warmup=1, label="serve")
-            with san:
-                for _ in range(8):
-                    with san.step():
-                        runtime.predict(Xq)
-            san_rec = san.report()
-            # violations fail AFTER the JSON line below is printed, so
-            # the chip-queue log always has the counter evidence
-        server = PredictionServer(registry, flush_deadline_ms=2.0,
-                                  model_poll_seconds=0)
-        with server:
-            misses_before = profiling.counter_value("serve.cache_miss")
-            load = _sustained_load(server, X)
-            misses_after = profiling.counter_value("serve.cache_miss")
-            stats = server.stats()
+        for variant in ("raw", "binned"):
+            registry = ModelRegistry(
+                model_path, params={"verbose": -1}, max_batch_rows=4096,
+                warmup_buckets=tuple(warm) or (ROWS_PER_REQ,),
+                replicas=REPLICAS, serve_quantize=variant)
+            runtime = registry.current()
+            assert runtime.variant == variant
+            if sanitize_enabled():
+                Xq = np.ascontiguousarray(X[:ROWS_PER_REQ], np.float64)
+                san = HotPathSanitizer(warmup=1, label=f"serve-{variant}")
+                with san:
+                    for _ in range(8):
+                        with san.step():
+                            runtime.predict(Xq)
+                san_rec[variant] = san.report()
+                sans.append(san)
+                # violations fail AFTER the JSON line below is printed,
+                # so the chip-queue log always has the counter evidence
+            server = PredictionServer(registry, flush_deadline_ms=2.0,
+                                      model_poll_seconds=0)
+            with server:
+                # delta-snapshot the process-global counters around the
+                # sustained window: the quantize A/B and warmup already
+                # ran binned traffic in this process, and the committed
+                # artifact must describe THIS phase only
+                misses_before = profiling.counter_value("serve.cache_miss")
+                qb_before = profiling.counter_value(
+                    profiling.SERVE_QUANTIZE_BYTES_IN)
+                br_before = profiling.counter_value(
+                    profiling.SERVE_BINNED_REQUESTS)
+                loads[variant] = _sustained_load(server, X)
+                misses_after = profiling.counter_value("serve.cache_miss")
+                stats[variant] = server.stats()
+                loads[variant]["warm_cache_misses"] = (misses_after
+                                                       - misses_before)
+                loads[variant]["quantize_bytes_in"] = (
+                    profiling.counter_value(
+                        profiling.SERVE_QUANTIZE_BYTES_IN) - qb_before)
+                loads[variant]["binned_requests"] = (
+                    profiling.counter_value(
+                        profiling.SERVE_BINNED_REQUESTS) - br_before)
 
+    load = loads["binned"]
     out = {
         "metric": f"serve fleet {FEATURES}f {TREES} trees depth<={DEPTH}: "
-                  "p99 request latency under sustained load",
+                  "p99 request latency under sustained load "
+                  "(serve_quantize=binned)",
         "value": load.get("p99_ms"),
         "unit": "ms",
         "train_s": round(train_s, 1),
         "model": {"trees": TREES, "num_leaves": LEAVES,
                   "max_depth": DEPTH, "depth_grown": int(depth_grown)},
         "kernel_ab": ab,
-        "sustained": load,
-        "replicas": stats["replicas"],
-        "batch_workers": stats["batch_workers"],
-        "batches": stats["batches"],
-        "warm_cache_misses": misses_after - misses_before,
-        "generation": stats["generation"],
+        "quantize_ab": qab,
+        "sustained": loads,
+        "replicas": stats["binned"]["replicas"],
+        "batch_workers": stats["binned"]["batch_workers"],
+        "quantize_bytes_in": loads["binned"]["quantize_bytes_in"],
+        "binned_requests": loads["binned"]["binned_requests"],
+        "generation": stats["binned"]["generation"],
     }
-    if san_rec is not None:
+    if san_rec:
         out["sanitize"] = san_rec
     line = json.dumps(out)
     print(line)
@@ -272,15 +359,24 @@ def main() -> None:
     if dest:
         with open(dest, "w") as f:
             f.write(line + "\n")
-    if "error" in load:
-        raise SystemExit(f"sustained load failed: {load['error']}")
-    if san is not None:
+    for variant, rec in loads.items():
+        if "error" in rec:
+            raise SystemExit(f"sustained load ({variant}) failed: "
+                             f"{rec['error']}")
+    for san in sans:
         san.check()     # fail AFTER the JSON so counters are recorded
     if os.environ.get("SERVE_BENCH_REQUIRE_SPEEDUP", ""):
         need = float(os.environ["SERVE_BENCH_REQUIRE_SPEEDUP"])
         if ab["speedup"] < need:
             raise SystemExit(
                 f"kernel A/B speedup {ab['speedup']} < required {need}")
+    if os.environ.get("SERVE_BENCH_REQUIRE_BINNED", ""):
+        need = float(os.environ["SERVE_BENCH_REQUIRE_BINNED"])
+        ratio = (qab["binned"]["rows_per_s"] / qab["raw"]["rows_per_s"])
+        if ratio < need:
+            raise SystemExit(
+                f"quantize A/B binned/raw throughput {ratio:.3f} < "
+                f"required {need}")
 
 
 if __name__ == "__main__":

@@ -158,6 +158,18 @@ def main():
         "queue.  High-water mark: a single over-cap request on an idle "
         "server still admits (the runtime chunks it), bounding the "
         "queue at cap + one request.  `0` = unbounded.",
+        "- `serve_quantize` (default `auto`, aliases "
+        "`serving_quantize`, `quantized_serving`): request-path "
+        "feature quantization.  `binned` quantizes every request "
+        "chunk against the model's `.refbin` frozen-mapper sidecar at "
+        "ingress (uint8/uint16 bin ids, a >=4x smaller device buffer "
+        "than f32) and traverses integer bins end-to-end — "
+        "bit-identical scores to the raw kernel by construction, and "
+        "the registry REFUSES a serve/swap whose sidecar is missing, "
+        "torn, or sha1-mismatched vs the publish meta.  `raw` keeps "
+        "f32 feature traversal.  `auto` picks binned whenever a valid "
+        "sidecar is present and falls back to raw otherwise.  See "
+        "docs/serving.md \"Binned inference\".",
         "",
         "## Online learning",
         "",

@@ -50,10 +50,13 @@ stage bench_narrow_on  env BENCH_ITERS=12 python bench.py || exit 1
 stage bench_sanitize_rounds env BENCH_SANITIZE=1 BENCH_TREE_GROWTH=rounds BENCH_ITERS=8 python bench.py || exit 1
 stage bench_sanitize_fused  env BENCH_SANITIZE=1 BENCH_TREE_GROWTH=exact  BENCH_ITERS=8 python bench.py || exit 1
 stage profile env BENCH_SANITIZE=1 python scripts/profile_hotpath.py || exit 1
-# serving fleet: sustained-QPS smoke + predict-kernel A/B at the
-# north-star model shape, gated on the sanitizer (0 retraces / 0
+# serving fleet: sustained-QPS smoke (raw AND binned sides) +
+# predict-kernel and serve_quantize A/Bs at the north-star model
+# shape, gated on the sanitizer for BOTH variants (0 retraces / 0
 # implicit transfers at steady state — fails AFTER its JSON prints)
-stage bench_serve env BENCH_SANITIZE=1 SERVE_BENCH_SECONDS=10 SERVE_BENCH_OUT=.bench/bench_serve.json python scripts/bench_serve.py || exit 1
+# and on binned throughput >= raw (the fixed-point traversal's
+# memory-bandwidth win must be real on chip)
+stage bench_serve env BENCH_SANITIZE=1 SERVE_BENCH_SECONDS=10 SERVE_BENCH_REQUIRE_BINNED=1.0 SERVE_BENCH_OUT=.bench/bench_serve.json python scripts/bench_serve.py || exit 1
 # online-learning refresh loop at the reduced north-star shape:
 # refit-vs-retrain wall-clock (>= 10x gate) + AUC-after-drift recovery,
 # steady-state refits under the sanitizer (0 retraces / 0 implicit

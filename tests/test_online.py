@@ -587,6 +587,10 @@ def test_online_trainer_refit_cycle_and_sidecar(tmp_path):
     assert meta["generation"] == 1 and meta["mode"] == "refit"
     assert meta["rows"] == 400 and meta["trigger_rows"] == 256
     assert meta["refresh_seconds"] >= 0
+    # the publish meta fingerprints the frozen-mapper sidecar: the
+    # serving registry refuses a binned hot-swap on mismatch
+    from lightgbm_tpu.quantize import file_sha1
+    assert meta["refbin_sha1"] == file_sha1(pub + ".refbin")
     # the window resets after a publish; the refitter is reused
     assert tr.pending_rows() == 0
     append_traffic(traffic, X[1400:], flipped[1400:])

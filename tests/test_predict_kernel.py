@@ -131,30 +131,34 @@ def test_categorical_routes_through_soa_bitwise():
     assert np.array_equal(ref, got)
 
 
-def test_default_left_routes_nan_left():
-    """The default-left lane: NaN goes LEFT on flagged numerical nodes
-    (the walk kernel always sends NaN right)."""
+def test_nan_routes_right_and_no_dead_lane():
+    """NaN rows route RIGHT in both kernels (``v <= t`` is False; the
+    categorical compare's finite mask matches nothing), and the node
+    record carries exactly the five live lanes — the never-populated
+    ``default_left`` lane PR 7 reserved is deleted (binned serving
+    derives missing routing from the quantizer's sentinel bin
+    instead; tests/test_serve_binned.py)."""
+    from lightgbm_tpu.ops.predict import _LANES, EnsembleMeta
+    assert _LANES == 5
+    assert "any_default_left" not in EnsembleMeta._fields
     rng = np.random.RandomState(5)
     F = 4
-    t = _rand_tree(rng, F, leaves=8, maxdepth=3)
-    t.default_left = np.ones(t.max_leaves - 1, bool)
+    t = _rand_tree(rng, F, leaves=8, maxdepth=3, dyadic=True)
     X = rng.rand(64, F).astype(np.float32)
     X[10:, :] = np.nan
-    got, st = _tens_raw([[t]], X)
-    from lightgbm_tpu.ops.predict import EnsembleStack
-    assert isinstance(st, EnsembleStack)   # dl lane vetoes perfect layout
+    got, st = _tens_raw([[t]], X, layout="soa")
+    assert st.nodes.shape[-1] == 5
     ref = _walk_raw([[t]], X)
-    # finite rows identical; all-NaN rows land on the leftmost leaf
-    assert np.array_equal(ref[0][:10], got[0][:10])
-    leftmost = 0
+    assert np.array_equal(ref, got)
+    # all-NaN rows land on the rightmost leaf (every compare fails)
     node = 0
     while True:
-        nxt = int(t.left_child[node])
+        nxt = int(t.right_child[node])
         if nxt < 0:
-            leftmost = ~nxt
+            rightmost = ~nxt
             break
         node = nxt
-    assert np.allclose(got[0][10:], t.leaf_value[leftmost])
+    assert np.allclose(got[0][10:], t.leaf_value[rightmost])
 
 
 def test_deep_ensemble_over_budget_uses_soa(monkeypatch):
