@@ -60,10 +60,20 @@ HIST_DTYPE = os.environ.get("BENCH_HIST_DTYPE", "int8")
 # 255 is the tracked north-star config; 63 is the reference accelerator
 # sweet spot (docs/GPU-Performance.md:153-156) measured as a variant
 BINS = int(os.environ.get("BENCH_BINS", 255))
-# "higgs" (tracked) or "onehot" (EFB acceptance shape: 240 one-hot
-# columns, 100% exclusive; A/B with BENCH_ENABLE_BUNDLE=0/1)
+# "higgs" (tracked), "onehot" (EFB acceptance shape: 240 one-hot
+# columns, 100% exclusive; A/B with BENCH_ENABLE_BUNDLE=0/1), or "ctr"
+# (wide-sparse hashed-count ranking shape, lambdarank over query
+# groups — the sparse-store acceptance workload, docs/Sparse.md;
+# A/B with BENCH_SPARSE_STORE=dense|csr and BENCH_BIN_BUDGET)
 WORKLOAD = os.environ.get("BENCH_WORKLOAD", "higgs")
 ENABLE_BUNDLE = os.environ.get("BENCH_ENABLE_BUNDLE", "1") != "0"
+# CTR shape knobs: feature count, nnz density, query size; the sparse
+# store (auto|csr|dense) and adaptive bin budget ride the same A/B envs
+CTR_FEATURES = int(os.environ.get("BENCH_CTR_FEATURES", 50_000))
+CTR_DENSITY = float(os.environ.get("BENCH_CTR_DENSITY", 0.01))
+CTR_QUERY = int(os.environ.get("BENCH_CTR_QUERY", 20))
+SPARSE_STORE = os.environ.get("BENCH_SPARSE_STORE", "")
+BIN_BUDGET = int(os.environ.get("BENCH_BIN_BUDGET", "0") or 0)
 # row feed of the histogram passes: "" keeps the config default (auto =
 # gathered on single-device TPU, masked elsewhere); set gathered|masked
 # for the ordered-histograms A/B (docs/Readme.md "Row partition")
@@ -190,6 +200,32 @@ def synth_higgs(n, f=28, seed=42):
     return X.astype(np.float64), y
 
 
+def synth_ctr(n, features=50_000, density=0.01, seed=42, query=20):
+    """Wide-sparse CTR/ranking shape (BENCH_WORKLOAD=ctr): hashed COUNT
+    features — popularity-skewed column draw (power-law, so a few hot
+    columns carry most mass and many distinct values, the regime
+    adaptive bin budgets target) with lognormal values, graded 0/1
+    relevance in `query`-row queries for lambdarank (ROADMAP item 4's
+    recommender/ads class).  Returns (scipy CSR X, y, group sizes)."""
+    import scipy.sparse as spm
+    rng = np.random.RandomState(seed)
+    n = max(query, (n // query) * query)
+    nnz = max(1, int(round(features * density)))
+    cols = (features * rng.rand(n * nnz) ** 3.0).astype(np.int64)
+    np.clip(cols, 0, features - 1, out=cols)
+    rows = np.repeat(np.arange(n), nnz)
+    vals = np.exp(rng.randn(n * nnz))
+    X = spm.csr_matrix((vals, (rows, cols)), shape=(n, features))
+    X.sum_duplicates()
+    # the labeling function is FIXED (seed 0), like synth_higgs
+    w = np.random.RandomState(0).randn(features) / np.sqrt(nnz)
+    lin = np.asarray(X @ w).ravel()
+    logits = lin + 0.5 * np.sin(3.0 * lin)
+    y = (logits + rng.logistic(size=n) * 0.3 > 0).astype(np.float64)
+    group = np.full(n // query, query, np.int64)
+    return X, y, group
+
+
 def synth_onehot(n, groups=40, card=6, seed=42):
     """One-hot-heavy EFB acceptance shape (BENCH_WORKLOAD=onehot):
     groups*card columns, exactly one non-zero per group per row — 100%
@@ -219,8 +255,24 @@ def main():
                 "tracked metric")
     import lightgbm_tpu as lgb
 
+    group = None
     if WORKLOAD == "onehot":
         X, y = synth_onehot(ROWS)
+    elif WORKLOAD == "ctr":
+        ctr_features = CTR_FEATURES
+        if "BENCH_ROWS" not in os.environ:
+            # the north-star 10.5M default is a HIGGS-shape number: at
+            # 50k features x 1% density its COO staging alone is
+            # >100 GB of host RAM — cap the ctr default (explicit
+            # BENCH_ROWS is honored as given)
+            ROWS = min(ROWS, 1_000_000)
+        if note:
+            # dense-store A/B must stay feasible on the CPU fallback
+            ROWS = min(ROWS, 32_768)
+            ctr_features = min(ctr_features, 8_192)
+        X, y, group = synth_ctr(ROWS, ctr_features, CTR_DENSITY,
+                                query=CTR_QUERY)
+        ROWS = len(y)
     else:
         X, y = synth_higgs(ROWS)
     params = {
@@ -233,6 +285,28 @@ def main():
         # single-precision trade, docs/GPU-Performance.md:130-134)
         "histogram_dtype": HIST_DTYPE,
     }
+    if WORKLOAD == "onehot":
+        # the EFB A/B must isolate bundling: the nobundle side's 240
+        # one-hot columns would otherwise auto-resolve the csr store on
+        # TPU and compare two different code paths
+        params["sparse_store"] = "dense"
+    if WORKLOAD == "ctr":
+        # wide-sparse ranking: lambdarank over the query groups; the
+        # int8 gradient quantization is a masked-kernel feature the
+        # sparse kernels do not implement — keep f32 unless pinned
+        params.update(objective="lambdarank", metric="ndcg")
+        if "BENCH_HIST_DTYPE" not in os.environ:
+            params["histogram_dtype"] = "float32"
+        # FindBin densifies its row sample: the default 200k-row sample
+        # at 50k features is an 80 GB float64 matrix — cap it (hashed
+        # one-hot/count columns saturate their distinct values long
+        # before 20k rows)
+        params.setdefault("bin_construct_sample_cnt",
+                          int(os.environ.get("BENCH_CTR_SAMPLE", 20_000)))
+    if SPARSE_STORE:
+        params["sparse_store"] = SPARSE_STORE
+    if BIN_BUDGET:
+        params["bin_budget"] = BIN_BUDGET
     if HIST_ROWS:
         params["hist_rows"] = HIST_ROWS
     if TREE_GROWTH:
@@ -240,7 +314,12 @@ def main():
     if HIST_EXCHANGE:
         params["hist_exchange"] = HIST_EXCHANGE
     cache_tag = WORKLOAD if ENABLE_BUNDLE else f"{WORKLOAD}_nobundle"
-    train = binned_dataset(cache_tag, X, y, params)
+    if WORKLOAD == "ctr":
+        # no binned-store cache: the fingerprint samples dense rows and
+        # the scipy matrix constructs via from_csc directly
+        train = lgb.Dataset(X, y, group=group).construct(params)
+    else:
+        train = binned_dataset(cache_tag, X, y, params)
     bst = lgb.Booster(params, train)
     narrow_fallback = False
     try:
@@ -267,6 +346,7 @@ def main():
     rows_t0 = profiling.counter_value(profiling.HIST_ROWS_TOUCHED)
     hx_t0 = profiling.counter_value(profiling.HIST_EXCHANGE_BYTES)
     sr_t0 = profiling.counter_value(profiling.SPLIT_RECORDS_BYTES)
+    nz_t0 = profiling.counter_value(profiling.SPARSE_NNZ_TOUCHED)
     san = None
     import contextlib
     trace_ctx = (profiling.device_trace(TRACE_DIR) if TRACE_DIR
@@ -300,6 +380,8 @@ def main():
         profiling.HIST_EXCHANGE_BYTES) - hx_t0) / ITERS
     sr_bytes_per_iter = (profiling.counter_value(
         profiling.SPLIT_RECORDS_BYTES) - sr_t0) / ITERS
+    nnz_per_iter = (profiling.counter_value(
+        profiling.SPARSE_NNZ_TOUCHED) - nz_t0) / ITERS
 
     root = os.path.dirname(os.path.abspath(__file__))
     vs = 0.0
@@ -390,11 +472,26 @@ def main():
                 _padded_bin_count(BINS + 1), HIST_DTYPE),
             "hist_chunk_env": int(_h.HIST_CHUNK),
             "masked_hist_chunk": int(_h.MASKED_HIST_CHUNK),
-            "hist_dtype": HIST_DTYPE,
+            "hist_dtype": params["histogram_dtype"],
             "narrow_compile_fallback": narrow_fallback,
         },
         "bundling": bundling,
     }
+    if WORKLOAD == "ctr" or inner.sparse is not None:
+        # sparse-store evidence: cells touched per iteration — stored
+        # entries on the nonzero-iterating path vs rows x store columns
+        # on the dense path; the ratio is the acceptance gate
+        # (docs/Sparse.md, scripts/run_ctr_ab.py)
+        out["sparse"] = {
+            "sparse_store": "csr" if inner.sparse is not None else "dense",
+            "nnz": 0 if inner.sparse is None else int(inner.sparse.nnz),
+            "nnz_touched_per_iter": round(nnz_per_iter, 1),
+            "dense_cells_per_iter": round(
+                rows_per_iter * inner.num_store_columns, 1),
+            "sparse_fallbacks": profiling.counter_value(
+                profiling.SPARSE_FALLBACKS),
+            "bin_budget": int(params.get("bin_budget", 0)),
+        }
     if san is not None:
         out["sanitize"] = san.report()
     if TRACE_DIR:

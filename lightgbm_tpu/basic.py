@@ -22,20 +22,32 @@ from .log import LightGBMError  # noqa: F401  (canonical error type)
 _sparse_densify_warned = False
 
 
-def _warn_sparse_densify(shape) -> None:
+def _warn_sparse_densify(shape, chunk_rows: int = 0) -> None:
     """One-time warning when a scipy-sparse matrix is materialized dense
-    (training avoids this via Dataset.from_csc; prediction still
-    densifies row chunks)."""
+    (training avoids this via Dataset.from_csc; the prediction paths
+    densify bounded row chunks).  Reports the estimated dense bytes —
+    the whole matrix, and the actual per-chunk peak when the caller
+    densifies in row slabs."""
     global _sparse_densify_warned
     if _sparse_densify_warned:
         return
     _sparse_densify_warned = True
     from . import log
-    est = shape[0] * shape[1] * 8
+    est = int(shape[0]) * int(shape[1]) * 8
+    if chunk_rows and chunk_rows < shape[0]:
+        peak = int(chunk_rows) * int(shape[1]) * 8
+        log.warning(
+            f"densifying a scipy sparse matrix of shape {tuple(shape)} "
+            f"in {chunk_rows}-row chunks (~{peak / 1e6:.1f} MB peak per "
+            f"chunk; {est / 1e6:.1f} MB = {est} bytes if whole, as "
+            "float64); pass training data as-is to Dataset so the "
+            "binner streams CSC columns instead")
+        return
     log.warning(
         f"densifying a scipy sparse matrix of shape {tuple(shape)} "
-        f"(~{est / 1e6:.1f} MB as float64); pass training data as-is to "
-        "Dataset so the binner streams CSC columns instead")
+        f"(~{est / 1e6:.1f} MB = {est} bytes as float64); pass training "
+        "data as-is to Dataset so the binner streams CSC columns "
+        "instead")
 
 
 def _is_scipy_sparse(data) -> bool:

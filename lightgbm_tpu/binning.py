@@ -490,16 +490,74 @@ def pack_bundle_column(b: np.ndarray, default_bin: int, offset: int,
     return conflicts
 
 
+def allocate_bin_budgets(distinct: np.ndarray, mass: np.ndarray,
+                         total_budget: int, min_bin: int = 2,
+                         max_bin_cap: int = 255) -> np.ndarray:
+    """Split a GLOBAL bin budget across features by distinct-value/mass
+    share (the Vectorized Adaptive Histograms allocation rule,
+    arXiv:2603.00326): feature f's weight is sqrt(distinct_f * mass_f)
+    — mass being the non-default sample count, where split resolution
+    actually matters — water-filled into [min(min_bin, distinct),
+    min(distinct, max_bin_cap)] so no feature holds more bins than it
+    has distinct values and none exceeds the uint8-store cap.  The
+    result is a per-feature `max_bin` vector for find_bin;
+    deterministic (pure integer numpy) so every rank/run agrees.
+
+    distinct / mass : [F] per-feature distinct-value and non-default
+        sample counts (zero injected — a constant feature has 1).
+    total_budget : global bin budget (uniform max_bin spends about
+        sum(min(distinct, max_bin)) of it).
+    """
+    d = np.maximum(np.asarray(distinct, np.int64), 1)
+    m = np.maximum(np.asarray(mass, np.int64), 1)
+    w = np.sqrt(d.astype(np.float64) * m.astype(np.float64))
+    cap = np.minimum(d, max_bin_cap)
+    lo = np.minimum(cap, min_bin)
+    alloc = lo.astype(np.int64).copy()
+    total = max(int(total_budget), int(lo.sum()))
+    # proportional waterfill; features hitting their cap release budget
+    # back to the pool (few rounds suffice: each round either exhausts
+    # the remainder or caps at least one feature)
+    for _ in range(64):
+        rem = total - int(alloc.sum())
+        if rem <= 0:
+            break
+        room = cap - alloc
+        open_w = np.where(room > 0, w, 0.0)
+        sw = open_w.sum()
+        if sw <= 0:
+            break
+        add = np.minimum(np.floor(rem * open_w / sw).astype(np.int64),
+                         room)
+        if int(add.sum()) == 0:
+            # sub-unit remainder: hand out one bin each down the weight
+            # order (stable, so ties resolve by feature index)
+            order = np.argsort(-open_w, kind="stable")
+            for j in order:
+                if rem <= 0:
+                    break
+                if room[j] > 0:
+                    alloc[j] += 1
+                    rem -= 1
+            break
+        alloc += add
+    return np.minimum(alloc, cap).astype(np.int32)
+
+
 def find_bin_mappers(X: np.ndarray, max_bin: int, min_data_in_bin: int,
                      min_split_data: int, categorical: Sequence[int] = (),
-                     sample_cnt: int = 200000, seed: int = 1
-                     ) -> List[BinMapper]:
+                     sample_cnt: int = 200000, seed: int = 1,
+                     bin_budget: int = 0) -> List[BinMapper]:
     """Find bin mappers for all columns of a dense matrix.
 
     Equivalent of DatasetLoader::ConstructBinMappersFromTextData
     (dataset_loader.cpp:661-837) for in-memory data: sample up to
     `sample_cnt` rows, then per-feature FindBin on the non-zero sampled
-    values.
+    values.  ``bin_budget > 0`` replaces the uniform per-feature
+    max_bin with the adaptive allocation of `allocate_bin_budgets`
+    (the global budget split by distinct-value/mass share, read off
+    each column's distinct-value summary — computed ONCE per column
+    and shared with the boundary search via find_bin_from_distinct).
     """
     n, f = X.shape
     rng = np.random.RandomState(seed)
@@ -511,11 +569,26 @@ def find_bin_mappers(X: np.ndarray, max_bin: int, min_data_in_bin: int,
         sample = X
         total = n
     cats = set(int(c) for c in categorical)
-    mappers = []
+    summaries = []
     for j in range(f):
         col = np.asarray(sample[:, j], dtype=np.float64)
-        nonzero = col[(col != 0.0) & ~np.isnan(col)]
+        nonzero = col[col != 0.0]      # NaNs dropped by _distinct_*
+        summaries.append(_distinct_with_zero(nonzero, total))
+    if bin_budget > 0 and f:
+        # distinct incl. the implied zero = vals.size; mass (non-zero
+        # sample count) = total minus the zero value's count
+        d = np.asarray([v.size for v, _ in summaries], np.int64)
+        m = np.asarray(
+            [total - int(c[v == 0.0].sum()) for v, c in summaries],
+            np.int64)
+        budgets = allocate_bin_budgets(d, m, bin_budget)
+    else:
+        budgets = None
+    mappers = []
+    for j, (vals, counts) in enumerate(summaries):
         bt = CATEGORICAL if j in cats else NUMERICAL
-        mappers.append(find_bin(nonzero, total, max_bin, min_data_in_bin,
-                                min_split_data, bt))
+        mb = int(budgets[j]) if budgets is not None else max_bin
+        mappers.append(find_bin_from_distinct(
+            vals, counts, total, mb, min_data_in_bin, min_split_data,
+            bt))
     return mappers

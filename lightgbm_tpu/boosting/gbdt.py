@@ -160,8 +160,10 @@ class GBDT:
         self.objective.init(train_set.metadata, self.num_data)
         self.K = self.objective.num_tree_per_iteration
         self.learner = create_tree_learner(train_set, cfg)
+        # bins_t resolves LAZILY (sparse stores materialize the dense
+        # transpose only if a consumer actually walks trees over it)
         self.train_score = ScoreUpdater(
-            self.learner.bins_t, self.num_data, self.K,
+            lambda: self.learner.bins_t, self.num_data, self.K,
             train_set.metadata.init_score,
             feat_tbl=train_set.bundle_feat_table())
         # continued training (input_model): replay the loaded model onto

@@ -183,9 +183,14 @@ def select_class_row(x, *, k: int):
 class ScoreUpdater:
     """Holds [K, N] float32 raw scores for one dataset."""
 
-    def __init__(self, bins_t: Optional[jax.Array], num_data: int, K: int,
+    def __init__(self, bins_t, num_data: int, K: int,
                  init_score: Optional[np.ndarray] = None, feat_tbl=None):
-        self.bins_t = bins_t
+        # bins_t: [N+1, C] array, None, or a ZERO-ARG CALLABLE resolved
+        # on first traversal — sparse training stores must not
+        # materialize their dense [N+1, C] transpose unless a consumer
+        # actually walks trees over it (leaf-partition score updates
+        # never do; docs/Sparse.md)
+        self._bins_src = bins_t
         # [5, F] bundle walk table when bins_t is an EFB store (see
         # _walk_step), None for the plain per-feature layout
         self.feat_tbl = None if feat_tbl is None else jnp.asarray(feat_tbl)
@@ -202,6 +207,13 @@ class ScoreUpdater:
             else:
                 raise ValueError("init score size mismatch")
         self.score = jnp.asarray(score)
+
+    @property
+    def bins_t(self):
+        src = self._bins_src
+        if callable(src):
+            src = self._bins_src = src()
+        return src
 
     def add_constant(self, val: float, tree_id: int) -> None:
         self.score = _add_const_to_row(
@@ -243,7 +255,7 @@ class ScoreUpdater:
         from ..ops.predict import (build_ensemble, predict_ensemble_binned,
                                    resolve_predict_kernel)
         if (resolve_predict_kernel(kernel) != "tensorized"
-                or len(trees) < 2 or self.bins_t is None):
+                or len(trees) < 2 or self._bins_src is None):
             for i, t in enumerate(trees):
                 self.add_tree(t, i % K)
             return

@@ -228,14 +228,54 @@ def dataset_from_mat(addr: int, type_code: int, nrow: int, ncol: int,
 
 
 def _dense_from_csr(indptr, indices, data, num_col: int) -> np.ndarray:
-    """Densify a CSR matrix — the dense store is this framework's
-    recorded design decision (README 'Not carried over': SparseBin);
-    sparse inputs are accepted at the ABI and densified on entry."""
+    """Densify a whole CSR matrix (dataset-construction entries, whose
+    downstream binner wants the full matrix anyway).  The PREDICT paths
+    never call this — they densify bounded row chunks via
+    `_csr_row_chunks` so a 10^6-row sparse predict peaks at one
+    chunk's dense bytes, not the whole matrix."""
     nrow = indptr.size - 1
     X = np.zeros((nrow, int(num_col)), np.float64)
     row = np.repeat(np.arange(nrow), np.diff(indptr).astype(np.int64))
     X[row, indices[: data.size]] = data
     return X
+
+
+def _predict_densify_chunk(num_col: int = 1) -> int:
+    """Row-slab size of the predict-path densify: the device predict
+    chunk cap, additionally BYTE-capped by the column count (a
+    262144-row float64 slab at 50k features would be ~105 GB — the
+    wide-sparse shape this path exists for).  ~256 MB per slab; the
+    device loop re-chunks rows internally, so a smaller slab costs
+    nothing."""
+    from .boosting.gbdt import GBDT
+    byte_cap = int(256e6) // max(int(num_col) * 8, 1)
+    return max(1024, min(int(GBDT._PREDICT_CHUNK), byte_cap))
+
+
+def _csr_row_chunks(indptr, indices, data, num_col: int, chunk: int):
+    """Yield dense [<=chunk, num_col] float64 row slabs of a CSR
+    matrix; peak memory is one slab + the sparse arrays."""
+    nrow = indptr.size - 1
+    for r0 in range(0, nrow, chunk):
+        r1 = min(nrow, r0 + chunk)
+        s, e = int(indptr[r0]), int(indptr[r1])
+        Xc = np.zeros((r1 - r0, int(num_col)), np.float64)
+        rows = np.repeat(np.arange(r0, r1),
+                         np.diff(indptr[r0:r1 + 1]).astype(np.int64)) - r0
+        Xc[rows, indices[s:e]] = data[s:e]
+        yield Xc
+
+
+def _csc_to_csr_arrays(col_ptr, indices, data, num_row: int):
+    """CSC → CSR index arrays (one nnz-sized stable sort, no dense
+    matrix) so the CSC predict path can reuse `_csr_row_chunks`."""
+    ncol = col_ptr.size - 1
+    cols = np.repeat(np.arange(ncol), np.diff(col_ptr).astype(np.int64))
+    rows = np.asarray(indices[: data.size])
+    order = np.argsort(rows, kind="stable")
+    indptr = np.concatenate([[0], np.cumsum(
+        np.bincount(rows, minlength=int(num_row)))]).astype(np.int64)
+    return indptr, cols[order], np.asarray(data)[order]
 
 
 def _dense_from_csc(col_ptr, indices, data, num_row: int) -> np.ndarray:
@@ -472,16 +512,33 @@ class CApiBooster:
         _view(out_addr, res.size, 1)[:] = res
         return int(res.size)
 
+    def _predict_sparse_chunks(self, indptr, indices, data, num_col,
+                               predict_type, num_iteration,
+                               out_addr) -> int:
+        """Chunked dense predict over CSR arrays: each row slab is
+        densified, scored, and written at its output offset — the full
+        dense matrix never exists."""
+        from .basic import _warn_sparse_densify
+        nrow = indptr.size - 1
+        chunk = _predict_densify_chunk(num_col)
+        _warn_sparse_densify((nrow, int(num_col)),
+                             chunk_rows=min(chunk, max(nrow, 1)))
+        total = 0
+        for Xc in _csr_row_chunks(indptr, indices, data, num_col, chunk):
+            res = self._predict(Xc, predict_type, num_iteration)
+            _view(out_addr, total + res.size, 1)[total:] = res
+            total += int(res.size)
+        return total
+
     def predict_for_csr(self, indptr_addr, indptr_type, indices_addr,
                         data_addr, data_type, nindptr, nelem, num_col,
                         predict_type, num_iteration, out_addr) -> int:
         indptr = _view(indptr_addr, nindptr, indptr_type).astype(np.int64)
         indices = _view(indices_addr, nelem, 2)
         data = _view(data_addr, nelem, data_type).astype(np.float64)
-        X = _dense_from_csr(indptr, indices, data, num_col)
-        res = self._predict(X, predict_type, num_iteration)
-        _view(out_addr, res.size, 1)[:] = res
-        return int(res.size)
+        return self._predict_sparse_chunks(indptr, indices, data, num_col,
+                                           predict_type, num_iteration,
+                                           out_addr)
 
     def predict_for_csc(self, col_ptr_addr, col_ptr_type, indices_addr,
                         data_addr, data_type, ncol_ptr, nelem, num_row,
@@ -489,10 +546,12 @@ class CApiBooster:
         col_ptr = _view(col_ptr_addr, ncol_ptr, col_ptr_type).astype(np.int64)
         indices = _view(indices_addr, nelem, 2)
         data = _view(data_addr, nelem, data_type).astype(np.float64)
-        X = _dense_from_csc(col_ptr, indices, data, num_row)
-        res = self._predict(X, predict_type, num_iteration)
-        _view(out_addr, res.size, 1)[:] = res
-        return int(res.size)
+        num_col = col_ptr.size - 1
+        indptr, cols, vals = _csc_to_csr_arrays(col_ptr, indices, data,
+                                                num_row)
+        return self._predict_sparse_chunks(indptr, cols, vals, num_col,
+                                           predict_type, num_iteration,
+                                           out_addr)
 
     def predict_for_file(self, data_filename: str, data_has_header: int,
                          predict_type: int, num_iteration: int,

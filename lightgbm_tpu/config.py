@@ -25,6 +25,14 @@ PREDICT_KERNELS = ("auto", "tensorized", "walk")
 # whenever a valid sidecar is present
 SERVE_QUANTIZE_MODES = ("auto", "binned", "raw")
 
+# the sparse_store dial's legal values — binned-store layout
+# (docs/Sparse.md): "csr" keeps per-row (store column, bin) nonzero
+# entries and the histogram kernels iterate only stored entries;
+# "dense" keeps the [F_eff, N] matrix; "auto" picks csr for wide
+# stores whose zero-bin rate clears `sparse_threshold` (and only when
+# `is_enable_sparse` is on — the reference's master sparse switch)
+SPARSE_STORE_MODES = ("auto", "csr", "dense")
+
 # Alias table: parity with reference config.h:342-436 (ParameterAlias).
 PARAM_ALIASES: Dict[str, str] = {
     "config": "config_file",
@@ -142,6 +150,13 @@ PARAM_ALIASES: Dict[str, str] = {
     "snapshot_path": "checkpoint_path",
     "checkpoint_freq": "checkpoint_interval",
     "snapshot_freq": "checkpoint_interval",
+    # sparse binned store + adaptive bin budgets (docs/Sparse.md)
+    "sparse_format": "sparse_store",
+    "store_format": "sparse_store",
+    "sparse_histogram": "sparse_store",
+    "total_bin_budget": "bin_budget",
+    "adaptive_bin_budget": "bin_budget",
+    "adaptive_bins": "bin_budget",
     # exclusive feature bundling (EFB)
     "efb": "enable_bundle",
     "bundle": "enable_bundle",
@@ -287,6 +302,30 @@ class Config:
     # non-default (0.0 = only provably exclusive features bundle).
     enable_bundle: bool = True
     max_conflict_rate: float = 0.0
+    # sparse binned store (docs/Sparse.md): "csr" packs the store as
+    # per-row (column id, bin) nonzero entries — implicit zeros bin to
+    # each column's known zero bin and are reconstructed from per-leaf
+    # totals, so histogram compute and bytes scale with nnz instead of
+    # F x N (the wide one-hot/hashed CTR regime, arXiv:1706.08359's
+    # sparse histogram kernel).  "auto" picks csr when the rounds
+    # growth schedule is already in play (tree_growth resolves rounds —
+    # the TPU default), the store is wide (>= 128 columns), and its
+    # estimated zero-bin rate is at least `sparse_threshold`; dense
+    # otherwise, so stock CPU configs are unchanged.
+    # `is_enable_sparse=false` (the reference's master sparse switch)
+    # keeps the AUTO resolution dense; an explicit csr/dense pins the
+    # layout outright.
+    sparse_store: str = "auto"
+    # adaptive per-feature bin budgets (docs/Sparse.md, the Vectorized
+    # Adaptive Histograms allocation, arXiv:2603.00326): a GLOBAL bin
+    # budget shared by all features, allocated by per-feature
+    # distinct-value/mass share (weight sqrt(distinct x nonzero_mass),
+    # floor 2, cap 255) so high-cardinality features get resolution
+    # where the mass is and one-hot columns stop wasting uniform
+    # max_bin slots.  0 = off (uniform max_bin per feature).  Mappers
+    # stay ordinary frozen BinMappers, so refbin/serving/binary-cache
+    # contracts are untouched.
+    bin_budget: int = 0
 
     # -- objective params (config.h:140-174)
     is_unbalance: bool = False
@@ -633,6 +672,13 @@ def check_param_conflict(cfg: Config) -> None:
                          "use refit or continue")
     if not (0.0 <= cfg.max_conflict_rate < 1.0):
         raise ValueError("max_conflict_rate must be in [0, 1)")
+    if cfg.sparse_store not in SPARSE_STORE_MODES:
+        raise ValueError(f"unknown sparse_store: {cfg.sparse_store}; "
+                         f"use one of {SPARSE_STORE_MODES}")
+    if not (0.0 < cfg.sparse_threshold <= 1.0):
+        raise ValueError("sparse_threshold must be in (0, 1]")
+    if cfg.bin_budget < 0:
+        raise ValueError("bin_budget must be >= 0 (0 = uniform max_bin)")
     if not (0 <= cfg.metrics_port <= 65535):
         raise ValueError("metrics_port must be in [0, 65535] (0 = off)")
 

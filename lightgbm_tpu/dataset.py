@@ -29,6 +29,137 @@ from .binning import (BinMapper, BundlePlan, find_bin_mappers,
                       plan_bundles, CATEGORICAL)
 from .config import Config
 
+# ----------------------------------------------------------------------------
+# Sparse binned store (docs/Sparse.md)
+# ----------------------------------------------------------------------------
+
+def nnz_capacity_tier(n: int, base: int = 4) -> int:
+    """Smallest power-of-two >= n (floor `base`): the ELL row width R of
+    a sparse store.  Device kernels key compiled shapes on R, so
+    datasets whose max per-row entry count lands in the same tier share
+    every compiled program — the ladder bounds compiles at O(log nnz),
+    the same contract as row_capacity_tier for streaming stores."""
+    cap = max(int(base), 1)
+    n = max(int(n), 1)
+    while cap < n:
+        cap <<= 1
+    return cap
+
+
+@dataclass
+class SparseStore:
+    """CSR/ELL-packed binned store: per row, up to R (store column,
+    bin) entries for exactly the cells whose bin differs from the
+    column's zero bin — the bin an implicit raw 0.0 maps to (the
+    feature's default bin; 0 = "all members at default" for EFB-packed
+    columns).  Implicit zeros are never stored: the histogram kernels
+    reconstruct each column's zero-bin row from per-leaf totals
+    (ops/histogram._apply_zero_bin), so compute and input bytes scale
+    with nnz instead of F x N.  `densify()` reproduces the dense store
+    bitwise — the entry set is lossless by construction."""
+    cols: np.ndarray      # [N, R] int32 store-column ids; C = empty slot
+    bins: np.ndarray      # [N, R] uint8/uint16 bin values
+    zero_bin: np.ndarray  # [C] int32 implicit-zero bin per store column
+    nnz: int = 0          # stored entries (excluding ELL padding)
+
+    @property
+    def num_columns(self) -> int:
+        return int(self.zero_bin.shape[0])
+
+    @property
+    def nnz_capacity(self) -> int:
+        return int(self.cols.shape[1])
+
+    def densify(self, dtype) -> np.ndarray:
+        """Materialize the dense [C, N] store (the fallback for
+        consumers without a sparse path; callers count it)."""
+        C = self.num_columns
+        n = self.cols.shape[0]
+        out = np.repeat(self.zero_bin.astype(dtype)[:, None], n, axis=1)
+        ri, sj = np.nonzero(self.cols < C)
+        out[self.cols[ri, sj], ri] = self.bins[ri, sj]
+        return out
+
+
+def _pack_ell(rows: np.ndarray, cols: np.ndarray, binvals: np.ndarray,
+              n: int, num_columns: int, zero_bin: np.ndarray,
+              dtype) -> SparseStore:
+    """Row-sorted COO entries -> ELL arrays at the nnz capacity tier."""
+    cnt = np.bincount(rows, minlength=n) if rows.size else \
+        np.zeros(n, np.int64)
+    R = nnz_capacity_tier(int(cnt.max(initial=1)))
+    ell_c = np.full((n, R), num_columns, np.int32)
+    ell_b = np.zeros((n, R), dtype)
+    if rows.size:
+        offs = np.concatenate([[0], np.cumsum(cnt)])
+        pos = np.arange(rows.size, dtype=np.int64) - offs[rows]
+        ell_c[rows, pos] = cols
+        ell_b[rows, pos] = binvals
+    return SparseStore(cols=ell_c, bins=ell_b,
+                       zero_bin=np.asarray(zero_bin, np.int32),
+                       nnz=int(rows.size))
+
+
+def store_zero_bins(mappers: List[BinMapper], used: Sequence[int],
+                    plan: Optional[BundlePlan]) -> np.ndarray:
+    """[C] int32 bin an implicit raw zero maps to, per STORE column:
+    the member feature's default bin for singleton columns, 0 ("every
+    member at its default") for EFB-packed columns."""
+    if plan is None:
+        return np.asarray([mappers[i].default_bin for i in used],
+                          np.int32)
+    zb = np.zeros(plan.num_columns, np.int32)
+    for k, i in enumerate(used):
+        if not plan.feat_packed[k]:
+            zb[int(plan.feat_col[k])] = int(mappers[i].default_bin)
+    return zb
+
+
+def resolve_sparse_store(cfg: Config, mappers: List[BinMapper],
+                         used: Sequence[int],
+                         plan: Optional[BundlePlan]) -> bool:
+    """Resolve the `sparse_store` knob for a store about to be built.
+
+    "auto" picks csr only when (1) `is_enable_sparse` is on (the
+    reference's master sparse switch), (2) the store is wide enough
+    that nnz-iteration can beat the dense kernels (>= 128 columns), and
+    (3) the estimated zero-bin rate — the mean of the mappers'
+    sampled `sparse_rate` over stored columns, with a packed column's
+    rate the complement of its members' summed non-default rates —
+    clears `sparse_threshold` (reference semantics: the zero fraction
+    above which a feature is worth storing sparse)."""
+    mode = getattr(cfg, "sparse_store", "dense")
+    if mode == "csr":
+        return True
+    if mode != "auto" or not cfg.is_enable_sparse or not used:
+        return False
+    # auto never changes the growth schedule out from under a default
+    # config: the nonzero-iterating kernels live in the rounds learner,
+    # so auto engages only where rounds is already the resolved default
+    # (TPU) or explicitly pinned — a CPU run with stock params keeps
+    # the exact learner over the dense store, byte-identical to pre-
+    # sparse behavior.  sparse_store=csr remains the explicit opt-in
+    # everywhere.
+    growth = getattr(cfg, "tree_growth", "auto")
+    if growth == "auto":
+        import jax
+        if jax.default_backend() != "tpu":
+            return False
+    elif growth != "rounds":
+        return False
+    C = plan.num_columns if plan is not None else len(used)
+    if C < 128:
+        return False
+    if plan is None:
+        rates = np.asarray([mappers[i].sparse_rate for i in used])
+    else:
+        nd = np.zeros(plan.num_columns)
+        for k, i in enumerate(used):
+            nd[int(plan.feat_col[k])] += 1.0 - mappers[i].sparse_rate
+        rates = 1.0 - np.minimum(nd, 1.0)
+    return float(np.mean(rates)) >= float(cfg.sparse_threshold)
+
+
 # rows used to estimate pairwise feature conflicts when planning bundles;
 # planning is O(sparse_features^2 * rows) so the sample is capped tighter
 # than bin_construct_sample_cnt (the estimate only gates which features
@@ -470,7 +601,7 @@ def load_file_two_round(path: str, cfg: Config,
         mappers = find_bin_mappers(
             sample, cfg.max_bin, cfg.min_data_in_bin, cfg.min_data_in_leaf,
             categorical=cats, sample_cnt=len(sample),
-            seed=cfg.data_random_seed)
+            seed=cfg.data_random_seed, bin_budget=cfg.bin_budget)
         used = [i for i, m in enumerate(mappers) if not m.is_trivial]
         plan = _plan_bundles_from_sample(sample, mappers, used, cfg)
         _log_bundle_state(plan, len(used), cfg)
@@ -542,7 +673,8 @@ class Dataset:
                     cfg.min_data_in_leaf,
                     categorical=categorical_feature,
                     sample_cnt=cfg.bin_construct_sample_cnt,
-                    seed=cfg.data_random_seed)
+                    seed=cfg.data_random_seed,
+                    bin_budget=cfg.bin_budget)
             self.used_features = [i for i, m in enumerate(self.mappers)
                                   if not m.is_trivial]
             plan = _plan_bundles_from_sample(X, self.mappers,
@@ -553,6 +685,11 @@ class Dataset:
         # (src/native/loader.cpp lgbt_bin_numerical); the rest via NumPy
         self._bin_rows_into(X, 0)
         self._check_realized_conflicts()
+        # sparse store: training sets only — valid sets are consumed
+        # dense by the score updater anyway (docs/Sparse.md)
+        if reference is None and resolve_sparse_store(
+                cfg, self.mappers, self.used_features, self.bundle_plan):
+            self._sparsify_store()
 
         md = metadata or Metadata()
         if label is not None:
@@ -562,6 +699,45 @@ class Dataset:
         if md.label.size != n:
             raise ValueError("label length mismatch")
         self.metadata = md
+        self._device_bins = None
+
+    # -- store access --------------------------------------------------------
+
+    @property
+    def bins(self) -> np.ndarray:
+        """[C, N] dense binned store.  A sparse dataset materializes it
+        LAZILY on first access — counted as tree/sparse_fallbacks so
+        silent densification is operator-visible (docs/Sparse.md lists
+        the consumers without a sparse path: feature-sharded learners,
+        binned score replay, binary-cache writes)."""
+        if self._bins is None and self.sparse is not None:
+            from . import log, profiling
+            profiling.count(profiling.SPARSE_FALLBACKS)
+            log.warning(
+                f"sparse store materialized dense ({self.num_store_columns}"
+                f" x {self.num_data} cells) for a consumer without a "
+                "sparse path")
+            self._bins = self.sparse.densify(self._store_dtype)
+        return self._bins
+
+    @bins.setter
+    def bins(self, value) -> None:
+        self._bins = value
+
+    def _sparsify_store(self) -> None:
+        """Convert the freshly-binned dense store to the CSR/ELL sparse
+        layout and drop the dense matrix.  The entry set — cells whose
+        bin differs from the column's zero bin — is lossless: densify()
+        reproduces the dense store bitwise, so sparse and dense
+        datasets built from the same rows train identical trees."""
+        zb = store_zero_bins(self.mappers, self.used_features,
+                             self.bundle_plan)
+        dense = self._bins
+        nz = dense != zb[:, None].astype(dense.dtype)
+        nzr, nzc = np.nonzero(nz.T)          # row-major entry order
+        self.sparse = _pack_ell(nzr, nzc, dense[nzc, nzr], dense.shape[1],
+                                dense.shape[0], zb, self._store_dtype)
+        self._bins = None
         self._device_bins = None
 
     # -- helpers ------------------------------------------------------------
@@ -590,6 +766,8 @@ class Dataset:
         C = len(self.store_num_bins)
         self.max_num_bin = int(self.store_num_bins.max()) if C else 1
         dtype = np.uint8 if self.max_num_bin <= 256 else np.uint16
+        self._store_dtype = dtype
+        self.sparse = None
         # packed columns rely on 0 meaning "all members at default"
         self.bins = (np.empty((C, n), dtype=dtype) if plan is None
                      else np.zeros((C, n), dtype=dtype))
@@ -652,6 +830,8 @@ class Dataset:
     def row_capacity(self) -> int:
         """Allocated row slots of the store (== num_data except for
         streaming datasets, whose store grows in capacity tiers)."""
+        if self._bins is None and self.sparse is not None:
+            return int(self.num_data)
         return int(self.bins.shape[1])
 
     @classmethod
@@ -783,12 +963,16 @@ class Dataset:
                  feature_names: Optional[List[str]] = None,
                  categorical_feature: Sequence[int] = (),
                  reference: Optional["Dataset"] = None) -> "Dataset":
-        """Construct from a scipy sparse matrix WITHOUT densifying it
-        whole: a row sample is densified once for BinMapper construction
-        (exactly what the dense path samples anyway), then each column is
-        densified one at a time and binned straight into the store.  Peak
-        memory ≈ binned store + sample + one dense column, instead of the
-        full N×F float64 matrix."""
+        """Construct from a scipy sparse matrix: a row sample is
+        densified once for BinMapper construction (exactly what the
+        dense path samples anyway); then, when `sparse_store` resolves
+        sparse, the CSR/ELL store is built DIRECTLY from the CSC
+        columns — one dense scratch column at a time, entries extracted
+        per store column, so peak memory is sample + one column + the
+        nnz-scaled store.  Otherwise (the dense fallback) each column is
+        densified one at a time and binned into the dense [C, N] store,
+        which still avoids the full N×F float64 matrix but pays the
+        dense store's memory and histogram cost."""
         sp = sp_matrix.tocsc()
         n, num_raw = sp.shape
         # ---- dense row sample for FindBin ---------------------------------
@@ -816,19 +1000,24 @@ class Dataset:
             mappers = find_bin_mappers(
                 sample, cfg.max_bin, cfg.min_data_in_bin,
                 cfg.min_data_in_leaf, categorical=categorical_feature,
-                sample_cnt=len(sample), seed=cfg.data_random_seed)
+                sample_cnt=len(sample), seed=cfg.data_random_seed,
+                bin_budget=cfg.bin_budget)
             used = [i for i, m in enumerate(mappers) if not m.is_trivial]
             plan = _plan_bundles_from_sample(sample, mappers, used, cfg)
             _log_bundle_state(plan, len(used), cfg)
         ds = cls._empty_from_mappers(cfg, mappers, used, n, num_raw,
                                      feature_names, plan=plan)
-        # ---- stream one dense column at a time ----------------------------
-        col = np.empty(n, np.float64)
-        for k, i in enumerate(used):
-            col[:] = 0.0
-            s, e = int(indptr[i]), int(indptr[i + 1])
-            col[indices[s:e]] = data[s:e]
-            ds._bin_column_into(k, col)
+        if reference is None and resolve_sparse_store(cfg, mappers, used,
+                                                      plan):
+            ds._build_sparse_from_csc(indptr, indices, data)
+        else:
+            # ---- stream one dense column at a time ----------------------
+            col = np.empty(n, np.float64)
+            for k, i in enumerate(used):
+                col[:] = 0.0
+                s, e = int(indptr[i]), int(indptr[i + 1])
+                col[indices[s:e]] = data[s:e]
+                ds._bin_column_into(k, col)
         ds._check_realized_conflicts()
         md = metadata or Metadata()
         if label is not None:
@@ -840,12 +1029,65 @@ class Dataset:
         ds.metadata = md
         return ds
 
+    def _build_sparse_from_csc(self, indptr, indices, data) -> None:
+        """Construct the CSR/ELL store STRAIGHT from scipy CSC arrays:
+        store columns are binned one dense [N] scratch at a time (the
+        dense route's exact per-column semantics, including EFB
+        last-writer-wins packing, so entries match the dense store
+        bitwise) and only the non-zero-bin cells are kept.  The dense
+        [C, N] matrix never materializes."""
+        from .quantize import bin_feature_column
+        n = self.num_data
+        plan = self.bundle_plan
+        used = self.used_features
+        zb = store_zero_bins(self.mappers, used, plan)
+        C = self.num_store_columns
+        members: List[List[int]] = [[] for _ in range(C)]
+        for k in range(len(used)):
+            c = k if plan is None else int(plan.feat_col[k])
+            members[c].append(k)
+        col = np.empty(n, np.float64)
+        scratch = np.zeros(n, self._store_dtype)
+        rows_l: List[np.ndarray] = []
+        cols_l: List[np.ndarray] = []
+        bins_l: List[np.ndarray] = []
+        for c in range(C):
+            scratch[:] = 0
+            for k in members[c]:
+                i = used[k]
+                col[:] = 0.0
+                s, e = int(indptr[i]), int(indptr[i + 1])
+                col[indices[s:e]] = data[s:e]
+                self.bundle_conflict_rows += bin_feature_column(
+                    k, col, self.mappers, used, plan, scratch)
+            nz = np.flatnonzero(scratch != int(zb[c]))
+            if nz.size:
+                rows_l.append(nz.astype(np.int64))
+                cols_l.append(np.full(nz.size, c, np.int64))
+                bins_l.append(scratch[nz].copy())
+        if rows_l:
+            rows = np.concatenate(rows_l)
+            colsv = np.concatenate(cols_l)
+            binsv = np.concatenate(bins_l)
+            order = np.argsort(rows, kind="stable")
+            rows, colsv, binsv = rows[order], colsv[order], binsv[order]
+        else:
+            rows = np.zeros(0, np.int64)
+            colsv = np.zeros(0, np.int64)
+            binsv = np.zeros(0, self._store_dtype)
+        self.sparse = _pack_ell(rows, colsv, binsv, n, C, zb,
+                                self._store_dtype)
+        self._bins = None
+        self._device_bins = None
+
     # -- bundle views --------------------------------------------------------
 
     @property
     def num_store_columns(self) -> int:
-        """Stored (histogrammed) columns — F_eff <= num_features."""
-        return int(self.bins.shape[0])
+        """Stored (histogrammed) columns — F_eff <= num_features.
+        Derived from the per-column metadata so a sparse store answers
+        without materializing the dense matrix."""
+        return int(len(self.store_num_bins))
 
     def bundle_feat_table(self) -> Optional[np.ndarray]:
         """[5, F] f32 walk/predicate table, or None when unbundled."""
@@ -1083,6 +1325,13 @@ class Dataset:
                               if "query_boundaries" in d else None),
             init_score=d["init_score"] if "init_score" in d else None)
         ds._device_bins = None
+        # the binary cache stores the dense layout; re-derive the
+        # sparse store when the config resolves csr so cache hits train
+        # the same path as fresh constructions (0-row refbin shells
+        # stay dense)
+        if ds.num_data and resolve_sparse_store(
+                cfg, ds.mappers, ds.used_features, ds.bundle_plan):
+            ds._sparsify_store()
         return ds
 
     @staticmethod

@@ -760,10 +760,23 @@ def create_tree_learner(dataset: Dataset, config: Config):
       where the gather-based exact learner is work-optimal).
     """
     lt = getattr(config, "tree_learner", "serial")
-    growth = getattr(config, "tree_growth", "auto")
+    growth0 = getattr(config, "tree_growth", "auto")
+    growth = growth0
     on_tpu = jax.default_backend() == "tpu"
     if growth == "auto":
         growth = "rounds" if on_tpu else "exact"
+    if getattr(dataset, "sparse", None) is not None and growth0 == "auto" \
+            and growth != "rounds" and lt not in ("feature", "voting"):
+        # the nonzero-iterating kernels live in the rounds learner; an
+        # exact-growth build over a sparse store would densify it, so
+        # `auto` resolves rounds wherever the store is sparse.  An
+        # EXPLICITLY pinned exact growth (and the feature-sharded /
+        # voting learners, which need per-feature store rows) takes the
+        # counted dense fallback instead.
+        from .. import log
+        log.info("sparse store: tree_growth=auto resolves to rounds "
+                 "(the nonzero-iterating histogram path)")
+        growth = "rounds"
 
     mesh = None
     if lt in ("data", "feature", "voting", "data2d"):

@@ -54,8 +54,10 @@ from .common import (gather_capacity_tiers, gather_scratch_capacity,
 from .fused import TreeArrays, tree_arrays_to_host
 from ..jaxutil import bag_mask_dev, pad_rows_dev, slice_rows_dev, \
     unstack_scalars
-from ..ops.histogram import hist_multileaf_gathered, hist_multileaf_masked
-from ..ops.partition import partition_rows
+from ..ops.histogram import (hist_multileaf_gathered, hist_multileaf_masked,
+                             hist_sparse_gathered, hist_sparse_multileaf,
+                             sparse_window_streams)
+from ..ops.partition import partition_rows, partition_rows_sparse
 from ..ops.split import (best_split, bundle_predicate_params,
                          combine_sharded_records, identity_feat_table,
                          leaf_output, maybe_unbundle, sharded_slice_search)
@@ -136,13 +138,15 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
                       hist_exchange: str = "psum",
                       num_devices: int = 1,
                       num_feature_shards: int = 1,
-                      leaves_per_batch: int = 0):
+                      leaves_per_batch: int = 0,
+                      sparse: bool = False):
     """Grow one tree in batched rounds.  Shapes as learner/fused.build_tree.
-    Returns (TreeArrays, leaf_id, stats) — stats is a [3] f32 vector:
+    Returns (TreeArrays, leaf_id, stats) — stats is a [4] f32 vector:
     (rows processed by histogram kernels — global across shards — the
     live-traffic metric behind the gathered-vs-masked A/B; per-device
     histogram-exchange payload bytes; per-device best-split-record
-    allgather bytes).
+    allgather bytes; stored sparse entries processed — global, 0 on
+    the dense path).
 
     hist_rows="gathered" maintains a device-resident row partition
     inside the while_loop: a [N] row permutation grouped by leaf plus
@@ -201,8 +205,33 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
     of keeping every leaf's [F, 3, B] histogram for the parent-subtraction
     trick, BOTH children are histogrammed directly — 2x histogram passes
     per round, O(1) leaf-hist memory.  The learner picks this mode when
-    L*F*3*B*4 bytes exceeds the histogram_pool_size budget."""
-    F, Nloc = bins.shape
+    L*F*3*B*4 bytes exceeds the histogram_pool_size budget.
+
+    sparse=True switches the row feed to the nonzero-iterating kernels
+    (docs/Sparse.md): `bins` is then the sparse-store pytree
+    (cols [Nloc, R], bins [Nloc, R], zero_bin [F], e_row, e_flat,
+    e_valid window streams — stream leaves carry a leading stacked-shard
+    axis under shard_map) and every histogram/partition touches only
+    stored entries, with the zero bin reconstructed from per-leaf
+    totals.  The reduced histogram keeps the dense [K, F, 3, B] layout,
+    so hist_exchange (psum / psum_scatter slice ownership) and the
+    round/compaction logic compose unchanged; gathered mode permutes
+    the ELL row segments exactly like dense rows.  The stats vector
+    gains a 4th element: stored entries touched by histogram kernels
+    (global across shards — the tree/sparse_nnz_touched counter)."""
+    if sparse:
+        sp_cols, sp_bins, sp_zb = bins[0], bins[1], bins[2]
+        # stream leaves arrive stacked with a leading shard axis (one
+        # block per shard under shard_map); squeeze it
+        sp_streams = tuple((a[0] if a.ndim == 3 else a) for a in bins[3:6])
+        sp_slots = bins[6][0] if bins[6].ndim == 2 else bins[6]
+        spt = (sp_cols, sp_bins, sp_zb) + sp_streams + (sp_slots,)
+        Nloc = sp_cols.shape[0]
+        F = sp_zb.shape[0]
+        # stored entries per masked pass (static shape, traced value)
+        nnz_pass = jnp.sum((sp_cols < F).astype(jnp.float32))
+    else:
+        F, Nloc = bins.shape
     L = num_leaves
     B = num_bins_padded
     K = leaves_per_batch or LEAVES_PER_BATCH
@@ -290,10 +319,26 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
     # int8-stored bins (value-128, see ops/histogram bin_offset) stay
     # narrow: a [F, N] int32 copy would be 4x the HBM (30.8 GB at Expo
     # shape); every consumer widens in fused ops / kernel VMEM
-    if bins.dtype == jnp.int8:
+    if sparse:
+        binsf = None
+    elif bins.dtype == jnp.int8:
         binsf = bins
     else:
         binsf = bins.astype(jnp.int32)
+
+    def hist_masked(lid_, sl_):
+        """One masked multi-leaf pass over the full store — dense
+        streaming or nonzero-iterating per the static `sparse` flag;
+        both return [K, F, 3, B]."""
+        if sparse:
+            return hist_sparse_multileaf(
+                spt, lid_, gh8, sl_, num_columns_padded=F,
+                num_bins_padded=B, backend=backend,
+                input_dtype=input_dtype)
+        return hist_multileaf_masked(
+            binsf, lid_, gh8, sl_, num_bins_padded=B, backend=backend,
+            input_dtype=input_dtype, max_num_bin=max_num_bin,
+            num_leaves=L)
 
     def find_best_batch(hists, sums):
         """hists [K2, C, 3, B] reduced STORE histograms (C = F, or this
@@ -343,10 +388,7 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
     gh8 = gh8.at[0].set(grad * row_mask).at[1].set(hess * row_mask)
     gh8 = gh8.at[2].set(row_mask)
     lid0 = jnp.zeros(Nloc, jnp.int32)
-    h0 = hist_multileaf_masked(binsf, lid0, gh8,
-                               jnp.zeros(1, jnp.int32), num_bins_padded=B,
-                               backend=backend, input_dtype=input_dtype,
-                               max_num_bin=max_num_bin, num_leaves=L)
+    h0 = hist_masked(lid0, jnp.zeros(1, jnp.int32))
     if hx:
         # leaf totals from the LOCAL pass (any single store column's bin
         # sums give them; store column 0 is always real) + one tiny
@@ -382,10 +424,13 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
         perm = jnp.zeros(0, jnp.int32)
         leaf_off = jnp.zeros(0, jnp.int32)
         leaf_cnt = jnp.zeros(0, jnp.int32)
-    # (rows touched by hist kernels, exchange bytes, record bytes) — the
-    # root contributes one masked full-stream pass + one exchange
+    # (rows touched by hist kernels, exchange bytes, record bytes,
+    # sparse entries touched) — the root contributes one masked
+    # full-stream pass + one exchange
     stats = jnp.asarray([float(Nloc), _exchange_bytes(1),
-                         _records_bytes(1)], jnp.float32)
+                         _records_bytes(1), 0.0], jnp.float32)
+    if sparse:
+        stats = stats.at[3].add(nnz_pass)
     leaf_best = jnp.full((L, 11), NEG_INF, jnp.float32).at[0].set(
         find_best_batch(hist0[None], root_sums[None])[0])
     leaf_depth = jnp.zeros(L, jnp.int32)
@@ -462,8 +507,14 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
 
         tbl = jnp.stack([srow(colv), srow(Tv), srow(catf), srow(new_leaf),
                          srow(lov), srow(hi1v), srow(dlv)])
-        leaf_id2 = partition_rows(binsf, leaf_id, tbl, num_slots=L + 1,
-                                  backend=backend, num_bins_padded=B)
+        if sparse:
+            leaf_id2 = partition_rows_sparse(sp_cols, sp_bins, sp_zb,
+                                             leaf_id, tbl,
+                                             num_slots=L + 1)
+        else:
+            leaf_id2 = partition_rows(binsf, leaf_id, tbl,
+                                      num_slots=L + 1, backend=backend,
+                                      num_bins_padded=B)
 
         # ---- stable row compaction (DataPartition::Split, vectorized) -----
         # Each splitting leaf's contiguous segment of `perm` divides into
@@ -563,13 +614,19 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
         K_MID = min(32, K)
 
         def hist_tiered(slv, dk, Kc):
-            full_call = functools.partial(
-                hist_multileaf_masked, num_bins_padded=B, backend=backend,
-                input_dtype=input_dtype, max_num_bin=max_num_bin,
-                num_leaves=L)
+            def full_call(slv_k):
+                if sparse:
+                    return hist_sparse_multileaf(
+                        spt, leaf_id2, gh8, slv_k, num_columns_padded=F,
+                        num_bins_padded=B, backend=backend,
+                        input_dtype=input_dtype)
+                return hist_multileaf_masked(
+                    binsf, leaf_id2, gh8, slv_k, num_bins_padded=B,
+                    backend=backend, input_dtype=input_dtype,
+                    max_num_bin=max_num_bin, num_leaves=L)
 
             def at(Kt):
-                h = full_call(binsf, leaf_id2, gh8, slv[:Kt])
+                h = full_call(slv[:Kt])
                 if Kt >= Kc:
                     return h
                 return jnp.concatenate(
@@ -577,7 +634,7 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
                     axis=0)
 
             if Kc <= K_SMALL:
-                return full_call(binsf, leaf_id2, gh8, slv)
+                return full_call(slv)
 
             def full_or_mid(_):
                 if Kc <= K_MID:
@@ -597,7 +654,8 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
             smallest static capacity tier holding this pass's live rows
             (lax.cond picks the tier at run time; every tier is one
             fixed-shape kernel, so nothing retraces round to round).
-            Returns ([Kc, F, 3, B] hists, f32 rows processed)."""
+            Returns ([Kc, F, 3, B] hists, f32 rows processed, f32
+            stored entries processed — 0 on the dense path)."""
             sc = jnp.clip(slv, 0, L - 1)
             act = slv >= 0
             so = jnp.where(act, jnp.take(leaf_off2, sc), 0)
@@ -606,10 +664,16 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
 
             def call(cap):
                 def f(_):
-                    return hist_multileaf_gathered(
+                    if sparse:
+                        return hist_sparse_gathered(
+                            (sp_cols, sp_bins, sp_zb), gh8, perm2, so,
+                            sn, capacity=cap, num_columns_padded=F,
+                            num_bins_padded=B)
+                    return (hist_multileaf_gathered(
                         binsf, gh8, perm2, so, sn, capacity=cap,
                         num_bins_padded=B, backend=backend,
-                        input_dtype=input_dtype, max_num_bin=max_num_bin)
+                        input_dtype=input_dtype,
+                        max_num_bin=max_num_bin), jnp.float32(0))
                 return f
 
             def pick(i):
@@ -621,7 +685,8 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
             rt_pass = jnp.float32(tiers[-1])
             for cap in tiers[-2::-1]:
                 rt_pass = jnp.where(total <= cap, jnp.float32(cap), rt_pass)
-            return pick(0)(None), rt_pass
+            h, nz = pick(0)(None)
+            return h, rt_pass, nz
 
         leaf_best2 = leaf_best
         leaf_hist2 = leaf_hist
@@ -636,11 +701,14 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
                 leaf_best2, leaf_hist2, stv = args
                 slv = jnp.where(dk, sl, -1)                  # -1 = empty slot
                 if gathered:
-                    h_small, rtp = hist_gathered_tiered(slv, tiers_small)
-                    stv = stv.at[0].add(rtp)
+                    h_small, rtp, nz = hist_gathered_tiered(slv,
+                                                            tiers_small)
+                    stv = stv.at[0].add(rtp).at[3].add(nz)
                 else:
                     h_small = hist_tiered(slv, dk, Kc)
                     stv = stv.at[0].add(jnp.float32(Nloc))
+                    if sparse:
+                        stv = stv.at[3].add(nnz_pass)
                 h_small = exchange(h_small)        # [Kc, F|Fs, 3, B]
                 stv = stv.at[1].add(_exchange_bytes(Kc))
                 if cache_parent_hist:
@@ -648,11 +716,14 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
                 else:
                     llv = jnp.where(dk, large_leaf[s:s + Kc], -1)
                     if gathered:
-                        h_large, rtp = hist_gathered_tiered(llv, tiers_all)
-                        stv = stv.at[0].add(rtp)
+                        h_large, rtp, nz = hist_gathered_tiered(llv,
+                                                                tiers_all)
+                        stv = stv.at[0].add(rtp).at[3].add(nz)
                     else:
                         h_large = hist_tiered(llv, dk, Kc)
                         stv = stv.at[0].add(jnp.float32(Nloc))
+                        if sparse:
+                            stv = stv.at[3].add(nnz_pass)
                     h_large = exchange(h_large)
                     stv = stv.at[1].add(_exchange_bytes(Kc))
                 rec_s = find_best_batch(h_small, small_sums[s:s + Kc])
@@ -697,10 +768,12 @@ def build_tree_rounds(bins, grad, hess, row_mask, num_bins, is_cat, fmask,
           leaf_side, leaf_hist, perm, leaf_off, leaf_cnt, stats,
           arrs)
     st = jax.lax.while_loop(round_cond, round_body, st)
-    # rows are summed across shards (global traffic); the byte counters
-    # stay per-device (passes are uniform, so every shard agrees)
+    # rows and sparse entries are summed across shards (global
+    # traffic); the byte counters stay per-device (passes are uniform,
+    # so every shard agrees)
     stv = st[-2]
-    return st[-1], st[1], stv.at[0].set(_psum(stv[0], row_axes))
+    stv = stv.at[0].set(_psum(stv[0], row_axes))
+    return st[-1], st[1], stv.at[3].set(_psum(stv[3], row_axes))
 
 
 class RoundsTreeLearner:
@@ -741,31 +814,47 @@ class RoundsTreeLearner:
         nbv = dataset.num_bins.astype(np.int32)      # ORIGINAL [F]
         icv = np.asarray(dataset.is_categorical)     # ORIGINAL [F]
         plan = dataset.bundle_plan
-        store = dataset.bins                         # [C, N] (bundled: C<F)
-        self.Cstore = store.shape[0]
-        if backend == "pallas" and dataset.max_num_bin <= 256 \
-                and self._want_int8_bins():
-            # int8 HBM layout (value - 128): 4x less device memory and
-            # bandwidth than int32 — what fits Expo's 11M x 700 store
-            # (7.7 GB vs 30.8 GB) on one v5e chip.  Memory-gated: the
-            # G=32 block layout it forces measured ~60% slower than the
-            # int32 G=8 layout on wide 255-bin data (Epsilon shape), so
-            # narrow storage is chosen only when int32 bins would crowd
-            # the device (see _want_int8_bins).
-            bins_np = (store.astype(np.int16) - 128).astype(np.int8)
-            # pad columns to the int8 kernel's 32-sublane group on the
-            # HOST: a device-side pad would briefly hold a second full
-            # copy of the bins array.  Padded columns are trivial
-            # (1 bin, fmask False) and can never be selected.
-            self.Fpad = 32 * int(math.ceil(self.Cstore / 32))
-        else:
-            bins_np = store.astype(np.int32)
+        # nonzero-iterating sparse path (docs/Sparse.md): single-process
+        # only for now — per-host stream assembly is the multi-host
+        # follow-on; the dense fallback below is counted by the
+        # dataset's bins property
+        self.sparse = dataset.sparse is not None and self.mh is None
+        if dataset.sparse is not None and not self.sparse:
+            from .. import log
+            log.warning("sparse store is not wired for multi-host runs "
+                        "yet; materializing the dense store")
+        if self.sparse:
+            bins_np = None
+            self.Cstore = dataset.sparse.num_columns
             self.Fpad = self.Cstore
+        else:
+            store = dataset.bins                     # [C, N] (bundled: C<F)
+            self.Cstore = store.shape[0]
+            if backend == "pallas" and dataset.max_num_bin <= 256 \
+                    and self._want_int8_bins():
+                # int8 HBM layout (value - 128): 4x less device memory and
+                # bandwidth than int32 — what fits Expo's 11M x 700 store
+                # (7.7 GB vs 30.8 GB) on one v5e chip.  Memory-gated: the
+                # G=32 block layout it forces measured ~60% slower than the
+                # int32 G=8 layout on wide 255-bin data (Epsilon shape), so
+                # narrow storage is chosen only when int32 bins would crowd
+                # the device (see _want_int8_bins).
+                bins_np = (store.astype(np.int16) - 128).astype(np.int8)
+                # pad columns to the int8 kernel's 32-sublane group on the
+                # HOST: a device-side pad would briefly hold a second full
+                # copy of the bins array.  Padded columns are trivial
+                # (1 bin, fmask False) and can never be selected.
+                self.Fpad = 32 * int(math.ceil(self.Cstore / 32))
+            else:
+                bins_np = store.astype(np.int32)
+                self.Fpad = self.Cstore
         # data-parallel histogram exchange: resolve the collective from
         # the per-pass payload, then (for psum_scatter) align the store
         # columns so the [K, F, 3, B] histogram tiles the data axis —
-        # each device owns an F/ndev store-column slice.  Alignment
-        # keeps the int8 kernel's 32-sublane grouping.
+        # each device owns an F/ndev store-column slice (the sparse
+        # path's REDUCED histogram keeps the dense column layout, so
+        # the same alignment applies).  Alignment keeps the int8
+        # kernel's 32-sublane grouping.
         K_pass = min(LEAVES_PER_BATCH, int(config.num_leaves))
         self.hist_exchange = resolve_hist_exchange(
             config, ndev=nsh,
@@ -773,17 +862,38 @@ class RoundsTreeLearner:
         if self.hist_exchange == "psum_scatter" and nsh > 1:
             self.Fpad = pad_cols_to_ndev(
                 self.Fpad, self._nd_sc,
-                align=32 if bins_np.dtype == np.int8 else 1)
-        # pad value must be an in-range bin; padded rows/features carry
-        # zero mask so their bin never matters
-        pad_val = -128 if bins_np.dtype == np.int8 else 0
-        if self.Fpad > self.Cstore:
-            fp = self.Fpad - self.Cstore
-            bins_np = np.pad(bins_np, ((0, fp), (0, 0)),
-                             constant_values=pad_val)
-        if self._local_np > self.N:
-            bins_np = np.pad(bins_np, ((0, 0), (0, self._local_np - self.N)),
-                             constant_values=pad_val)
+                align=32 if (bins_np is not None
+                             and bins_np.dtype == np.int8) else 1)
+        if self.sparse:
+            sps = dataset.sparse
+            cols_np = sps.cols.astype(np.int32)
+            ell_np = sps.bins.astype(np.int32)
+            # the empty-slot sentinel must sit PAST the padded columns,
+            # or scatter-aligned padding columns would accumulate
+            cols_np = np.where(cols_np >= self.Cstore,
+                               np.int32(self.Fpad), cols_np)
+            zb_np = np.full(self.Fpad, -1, np.int32)
+            zb_np[: self.Cstore] = sps.zero_bin
+            if self._local_np > self.N:
+                rp = self._local_np - self.N
+                cols_np = np.pad(cols_np, ((0, rp), (0, 0)),
+                                 constant_values=self.Fpad)
+                ell_np = np.pad(ell_np, ((0, rp), (0, 0)))
+            self._nnz = int(sps.nnz)
+            streams = self._build_sparse_streams(cols_np, ell_np, nsh,
+                                                 backend)
+        else:
+            # pad value must be an in-range bin; padded rows/features
+            # carry zero mask so their bin never matters
+            pad_val = -128 if bins_np.dtype == np.int8 else 0
+            if self.Fpad > self.Cstore:
+                fp = self.Fpad - self.Cstore
+                bins_np = np.pad(bins_np, ((0, fp), (0, 0)),
+                                 constant_values=pad_val)
+            if self._local_np > self.N:
+                bins_np = np.pad(bins_np,
+                                 ((0, 0), (0, self._local_np - self.N)),
+                                 constant_values=pad_val)
         if plan is None:
             # unbundled: split metadata mirrors the (padded) store columns
             fp = self.Fpad - self.F
@@ -821,12 +931,26 @@ class RoundsTreeLearner:
         # row feed: gathered (ordered histograms over the device-resident
         # row partition) vs masked full-stream — see build_tree_rounds.
         # Under shard_map the partition is per-shard local state, so the
-        # scratch budget is sized from the PER-SHARD row count
-        self.hist_rows = resolve_hist_rows(
-            cfg, backend=backend,
-            num_columns=self.Fpad,
-            np_rows=max(1, self.Np // max(nsh, 1)),
-            bins_itemsize=int(bins_np.dtype.itemsize))
+        # scratch budget is sized from the PER-SHARD row count.  The
+        # sparse store defaults to masked (its window entry streams are
+        # static store order — every masked pass is already nnz-scaled);
+        # explicit gathered composes on the XLA path, where the ELL row
+        # segments gather exactly like dense rows.
+        if self.sparse:
+            hr = getattr(cfg, "hist_rows", "auto")
+            if hr == "gathered" and backend == "pallas":
+                from .. import log
+                log.warning("hist_rows=gathered over the sparse store "
+                            "runs the XLA scatter path; using masked "
+                            "on TPU")
+                hr = "masked"
+            self.hist_rows = "masked" if hr == "auto" else hr
+        else:
+            self.hist_rows = resolve_hist_rows(
+                cfg, backend=backend,
+                num_columns=self.Fpad,
+                np_rows=max(1, self.Np // max(nsh, 1)),
+                bins_itemsize=int(bins_np.dtype.itemsize))
         kw = dict(num_leaves=cfg.num_leaves, num_bins_padded=self.B,
                   max_num_bin=int(dataset.max_num_bin),
                   split_kw=self.split_kw, max_depth=int(cfg.max_depth),
@@ -838,11 +962,16 @@ class RoundsTreeLearner:
                   hist_exchange=self.hist_exchange,
                   num_devices=self.dd,
                   num_feature_shards=self.df,
-                  ftbl=ftbl, unb=unb,
+                  ftbl=ftbl, unb=unb, sparse=self.sparse,
                   input_dtype=getattr(cfg, "histogram_dtype", "float32"))
         if mesh is None:
             self._build = jax.jit(functools.partial(build_tree_rounds, **kw))
-            self.bins_dev = jnp.asarray(bins_np)
+            if self.sparse:
+                self.bins_dev = ((jnp.asarray(cols_np),
+                                  jnp.asarray(ell_np), jnp.asarray(zb_np))
+                                 + tuple(jnp.asarray(s) for s in streams))
+            else:
+                self.bins_dev = jnp.asarray(bins_np)
         else:
             from jax.sharding import PartitionSpec as P, NamedSharding
             from ..sharded.mesh import compat_shard_map, row_shard_axes
@@ -851,9 +980,13 @@ class RoundsTreeLearner:
                 data_axis="data" if self.dd > 1 else None,
                 feature_axis="feature" if self.df > 1 else None)
             # rows shard over every mesh axis present (the 2-D mesh
-            # splits the row axis dd*df ways; store columns replicate)
+            # splits the row axis dd*df ways; store columns replicate).
+            # Sparse: ELL rows and the stacked stream blocks shard by
+            # rows; zero_bin replicates like the split metadata.
             da = row_shard_axes(self.dd, self.df)
-            in_specs = (P(None, da), P(da), P(da), P(da), P(), P(), P())
+            bins_spec = ((P(da), P(da), P(), P(da), P(da), P(da), P(da))
+                         if self.sparse else P(None, da))
+            in_specs = (bins_spec, P(da), P(da), P(da), P(), P(), P())
             out_specs = (jax.tree_util.tree_map(lambda _: P(), TreeArrays(
                 *[0] * len(TreeArrays._fields))), P(da), P())
             self._build = jax.jit(compat_shard_map(
@@ -861,6 +994,13 @@ class RoundsTreeLearner:
                 check_vma=False))
             if self.mh is not None:
                 self.bins_dev = self.mh.put_rows(bins_np, P(None, da))
+            elif self.sparse:
+                def put(a, spec):
+                    return jax.device_put(jnp.asarray(a),
+                                          NamedSharding(mesh, spec))
+                self.bins_dev = ((put(cols_np, P(da)), put(ell_np, P(da)),
+                                  put(zb_np, P()))
+                                 + tuple(put(s, P(da)) for s in streams))
             else:
                 self.bins_dev = jax.device_put(
                     jnp.asarray(bins_np), NamedSharding(mesh, P(None, da)))
@@ -868,6 +1008,37 @@ class RoundsTreeLearner:
         # (nbv/icv already carry the int8 feature padding)
         self.num_bins_dev = nbv if self.mh is not None else jnp.asarray(nbv)
         self.is_cat_dev = icv if self.mh is not None else jnp.asarray(icv)
+
+    def _build_sparse_streams(self, cols_np: np.ndarray,
+                              ell_np: np.ndarray, nsh: int, backend: str):
+        """Stacked per-shard window entry streams for the pallas sparse
+        kernel ([nsh, nwin, Ew], every shard padded to the common Ew so
+        the stacked leaves shard cleanly).  Off-TPU the XLA path
+        iterates the ELL arrays directly, so empty placeholders keep
+        the pytree structure without the host sort."""
+        from ..ops.histogram import FEATURE_GROUP
+        if backend != "pallas":
+            z = np.zeros((nsh, 0, 0), np.int32)
+            return (z, z.copy(), np.zeros((nsh, 0, 0), np.float32),
+                    np.zeros((nsh, 0), np.int32))
+        blocks = np.split(np.arange(cols_np.shape[0]), nsh)
+        parts = [sparse_window_streams(cols_np[b], ell_np[b], self.Fpad,
+                                       num_bins_padded=self.B)
+                 for b in blocks]
+        # pad every shard to the common window count (padding windows
+        # hold sentinel slots/entries and accumulate nothing)
+        nwin = max(p[0].shape[0] for p in parts)
+        sent = FEATURE_GROUP * self.B
+        out_r, out_f, out_v, out_s = [], [], [], []
+        for er, ef, ev, sc in parts:
+            pad = ((0, nwin - er.shape[0]), (0, 0))
+            out_r.append(np.pad(er, pad))
+            out_f.append(np.pad(ef, pad, constant_values=sent))
+            out_v.append(np.pad(ev, pad))
+            out_s.append(np.pad(sc, (0, nwin * FEATURE_GROUP - sc.size),
+                                constant_values=self.Fpad))
+        return (np.stack(out_r), np.stack(out_f), np.stack(out_v),
+                np.stack(out_s))
 
     def _want_int8_bins(self) -> bool:
         """Narrow bin storage only under memory pressure: int32 bins
@@ -967,10 +1138,11 @@ class RoundsTreeLearner:
     def _record_stats(self, profiling, stats) -> None:
         # one jitted unstack: eager stats[i] indexing lowers to
         # dynamic_slice and uploads its start index per iteration
-        s0, s1, s2 = unstack_scalars(3)(stats)
+        s0, s1, s2, s3 = unstack_scalars(4)(stats)
         profiling.count_deferred(profiling.HIST_ROWS_TOUCHED, s0)
         profiling.count_deferred(profiling.HIST_EXCHANGE_BYTES, s1)
         profiling.count_deferred(profiling.SPLIT_RECORDS_BYTES, s2)
+        profiling.count_deferred(profiling.SPARSE_NNZ_TOUCHED, s3)
 
     def train(self, grad: jax.Array, hess: jax.Array,
               bag_idx: Optional[jax.Array] = None,

@@ -915,6 +915,340 @@ def hist_multileaf_gathered(bins_fn: jax.Array, gh8: jax.Array,
                                  num_leaves=K if K <= 255 else 0)
 
 
+# ----------------------------------------------------------------------------
+# Sparse (nonzero-iterating) histogram pair — docs/Sparse.md
+#
+# The store is CSR/ELL-packed: each row carries up to R (column id, bin)
+# entries for the cells whose bin differs from the column's known zero
+# bin; implicit zeros are reconstructed per leaf as
+# `leaf_totals - sum(stored bins)` (exactly the subtraction the dense
+# paths already run for larger siblings and EFB default bins,
+# ops/split.unbundle_hist).  Compute and histogram input bytes scale
+# with nnz instead of F x N — the kernel shape of the sparse GPU
+# histogram (arXiv:1706.08359).  Two implementations mirror the dense
+# masked pair:
+# - `hist_sparse_xla`: per-entry scatter-add (segment-sum), pure XLA —
+#   the CPU/test path and the fallback.
+# - `hist_sparse_pallas`: entries pre-sorted into FEATURE_GROUP-column
+#   windows (ELL-per-window, built once per dataset by
+#   `sparse_window_streams`); each grid cell runs the masked kernel's
+#   leaf-mask + one-hot matmul over a [Eblk] entry block against the
+#   window's flat W*B bin axis, so the MXU contraction idiom carries
+#   over unchanged.
+# ----------------------------------------------------------------------------
+
+# entry-block length of the sparse pallas kernel: the [Eblk, W*B] f32
+# one-hot is the VMEM-dominant transient (512 * 1024 * 4 = 2 MB)
+SPARSE_CHUNK = 512
+
+
+def _slot_of_rows(lid: jax.Array, sl: jax.Array) -> jax.Array:
+    """Slot index per row (position of the row's leaf id in `sl`), or K
+    for rows whose leaf is not histogrammed this pass — K rows land in
+    the scratch slot every scatter below slices off."""
+    K = sl.shape[0]
+    eq = lid[:, None] == sl[None, :]                     # [N, K]
+    return jnp.where(jnp.any(eq, axis=1),
+                     jnp.argmax(eq, axis=1).astype(jnp.int32),
+                     jnp.int32(K))
+
+
+def _slot_totals(srow: jax.Array, gh8: jax.Array, K: int) -> jax.Array:
+    """[K, 3] per-slot (sum_grad, sum_hess, count) — the zero-bin
+    reconstruction anchor, accumulated over ALL rows of each slot."""
+    tot = jnp.zeros((K + 1, 3), jnp.float32)
+    return tot.at[srow].add(gh8[:3].T)[:K]
+
+
+def _apply_zero_bin(hist: jax.Array, tot: jax.Array,
+                    zero_bin: jax.Array) -> jax.Array:
+    """Reconstruct the implicit-zero bin row of every store column:
+    `leaf totals - sum(stored-entry bins)` added at the column's zero
+    bin.  hist [K, C, 3, B] (stored entries only), tot [K, 3],
+    zero_bin [C] (-1 marks padded columns, which must stay all-zero).
+    Exact for counts (integers < 2^24) and within one f32 rounding of
+    the dense accumulation for grad/hess — the same property the dense
+    paths accept from parent-histogram subtraction."""
+    colsum = jnp.sum(hist, axis=3)                       # [K, C, 3]
+    valid = (zero_bin >= 0).astype(jnp.float32)
+    resid = (tot[:, None, :] - colsum) * valid[None, :, None]
+    zb = jnp.clip(zero_bin, 0, hist.shape[3] - 1)
+    C = hist.shape[1]
+    # advanced-index add: the (arange, zb) pair broadcasts to [C], and
+    # with the interleaved slices the advanced axes move first → the
+    # update operand is [C, K, 3]
+    return hist.at[:, jnp.arange(C), :, zb].add(resid.transpose(1, 0, 2))
+
+
+@functools.partial(jax.jit, static_argnames=("num_columns_padded",
+                                             "num_bins_padded"))
+def hist_sparse_xla(cols: jax.Array, binsv: jax.Array, zero_bin: jax.Array,
+                    lid: jax.Array, gh8: jax.Array, sl: jax.Array, *,
+                    num_columns_padded: int,
+                    num_bins_padded: int) -> jax.Array:
+    """Nonzero-iterating multi-leaf histogram, XLA scatter-add path.
+
+    cols/binsv : [N, R] ELL entries (col >= num_columns_padded marks an
+        empty slot); zero_bin [Cp] int32 (-1 = padded column);
+    lid [N] int32 leaf ids; gh8 [8, N] f32 (grad·rm, hess·rm, rm, …);
+    sl [K] int32 leaf ids to histogram (-1 = empty slot).
+    Returns [K, Cp, 3, B] f32 — hist_multileaf_masked's contract over
+    the sparse store.
+    """
+    N, R = cols.shape
+    K = sl.shape[0]
+    Cp, B = num_columns_padded, num_bins_padded
+    srow = _slot_of_rows(lid, sl)                        # [N]
+    tot = _slot_totals(srow, gh8, K)
+    valid_e = cols < Cp                                  # [N, R]
+    # entries of unslotted rows and empty ELL slots both route to the
+    # K scratch slot (sliced off); column/bin ids stay in range
+    s_e = jnp.where(valid_e, srow[:, None], K).reshape(-1)
+    c_e = jnp.minimum(cols, Cp - 1).reshape(-1)
+    b_e = jnp.minimum(binsv, B - 1).reshape(-1)
+    v3 = jnp.stack([gh8[0], gh8[1], gh8[2]], axis=1)     # [N, 3]
+    v_e = jnp.broadcast_to(v3[:, None, :], (N, R, 3)).reshape(-1, 3)
+    hist = jnp.zeros((K + 1, Cp, B, 3), jnp.float32)
+    hist = hist.at[s_e, c_e, b_e].add(v_e)[:K]           # [K, Cp, B, 3]
+    hist = hist.transpose(0, 1, 3, 2)                    # [K, Cp, 3, B]
+    return _apply_zero_bin(hist, tot, zero_bin)
+
+
+def sparse_window_streams(cols: np.ndarray, binsv: np.ndarray,
+                          num_columns: int, *, num_bins_padded: int,
+                          window: int = FEATURE_GROUP,
+                          chunk: int = SPARSE_CHUNK):
+    """Slot-segmented entry streams for the pallas sparse kernel, built
+    ONCE per dataset on the host (the store is static; only leaf ids
+    and gradients change per pass).
+
+    Entries sort by store column and split into SLOTS of at most
+    `chunk` entries — a hot column simply occupies several slots (its
+    partial histograms are summed back at unscatter time), so the
+    layout is load-balanced by construction: real CTR column
+    distributions are power-law, and padding windows to the hottest
+    window's length would blow stream memory up by the skew factor
+    (~90x at the acceptance shape).  Here memory is
+    O(nnz + chunk * nonempty columns) regardless of skew.
+
+    `window` slots share one kernel grid cell; slot s occupies the
+    fixed segment [s*chunk, (s+1)*chunk) of its window's stream, so
+    every block is one slot's entries — a fully regular
+    (windows, window) grid, no scalar prefetch.
+
+    Returns (e_row [nwin, window*chunk] int32 local row ids,
+    e_flat [...] int32 flat local bin ids `lane * B + bin` with
+    sentinel window*B for padding, e_valid [...] f32 0/1,
+    slot_col [nwin*window] int32 store column per slot — sentinel
+    num_columns for padding slots; `unscatter_slot_hist` folds the
+    kernel output back to columns).
+    """
+    N, R = cols.shape
+    B = num_bins_padded
+    W = window
+    keep = (cols < num_columns).ravel()
+    r_e = np.repeat(np.arange(N, dtype=np.int64), R)[keep]
+    c_e = cols.ravel()[keep].astype(np.int64)
+    b_e = binsv.ravel()[keep].astype(np.int64)
+    order = np.argsort(c_e, kind="stable")
+    r_e, c_e, b_e = r_e[order], c_e[order], b_e[order]
+    cnt = np.bincount(c_e, minlength=int(num_columns))
+    nslot_c = -(-cnt // chunk)                     # 0 for empty columns
+    nslots = int(nslot_c.sum())
+    nsp = W * max(1, -(-max(nslots, 1) // W))      # pad to a window mult
+    slot_col = np.full(nsp, int(num_columns), np.int32)
+    slot_col[:nslots] = np.repeat(np.arange(num_columns), nslot_c)
+    # entry -> (slot, position): entries are column-sorted, so an
+    # entry's slot is its column's first slot + rank-in-column // chunk
+    col_off = np.concatenate([[0], np.cumsum(cnt)])
+    slot_base = np.concatenate([[0], np.cumsum(nslot_c)])
+    rank = np.arange(r_e.size, dtype=np.int64) - col_off[c_e]
+    s_e = slot_base[c_e] + rank // chunk
+    p_e = rank % chunk
+    nwin = nsp // W
+    Ew = W * chunk
+    e_row = np.zeros((nwin, Ew), np.int32)
+    e_flat = np.full((nwin, Ew), W * B, np.int32)
+    e_valid = np.zeros((nwin, Ew), np.float32)
+    w_e = s_e // W
+    pos = (s_e % W) * chunk + p_e
+    e_row[w_e, pos] = r_e
+    e_flat[w_e, pos] = (s_e % W) * B + b_e
+    e_valid[w_e, pos] = 1.0
+    return e_row, e_flat, e_valid, slot_col
+
+
+def unscatter_slot_hist(h_slots: jax.Array, slot_col: jax.Array,
+                        num_columns: int) -> jax.Array:
+    """[nslots, Mp, B] per-slot partial histograms -> [Cp, Mp, B] by
+    summing each column's slots (histograms are additive, so splitting
+    a hot column across slots is exact).  Sentinel slots drop."""
+    Cp = num_columns
+    out = jnp.zeros((Cp + 1,) + h_slots.shape[1:], h_slots.dtype)
+    return out.at[slot_col].add(h_slots)[:Cp]
+
+
+def _hist_kernel_sparse(sl_ref, fb_ref, lid_ref, gh_ref, out_ref, *,
+                        WB: int, K: int, input_dtype):
+    """One (window, entry-chunk) grid cell of the sparse histogram.
+
+    sl_ref : [Kp, 128] int32 slot leaf ids (replicated across lanes)
+    fb_ref : [1, Eblk] int32 flat local bin ids (sentinel WB matches
+             no lane)
+    lid_ref: [1, Eblk] int32 leaf id of each entry's row
+    gh_ref : [1, 8, Eblk] f32 (g·valid, h·valid, valid, pads)
+    out_ref: [1, Mp, WB] f32 accumulated across the chunk grid axis
+
+    Identical inner shape to _hist_kernel_masked (leaf masks in VMEM,
+    one [Mp, Eblk] @ [Eblk, WB] MXU contraction) — only the one-hot
+    axis is the window's flat (local column, bin) product.  The compare
+    runs in int32: flat ids reach W*B = 1024, past the int8/bf16 exact
+    windows the narrow dense compares rely on.
+    """
+    from jax.experimental import pallas as pl
+
+    k = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    lid = lid_ref[0, :]                                  # [Eblk]
+    sl = sl_ref[:K, 0:1]                                 # [K, 1]
+    m = (lid[None, :] == sl).astype(input_dtype)         # [K, Eblk]
+    g = gh_ref[0, 0:1, :].astype(input_dtype)
+    h = gh_ref[0, 1:2, :].astype(input_dtype)
+    rm = gh_ref[0, 2:3, :].astype(input_dtype)
+    vals = jnp.concatenate([m * g, m * h, m * rm], axis=0)   # [3K, Eblk]
+    Mp = out_ref.shape[1]
+    if Mp > 3 * K:
+        vals = jnp.concatenate(
+            [vals, jnp.zeros((Mp - 3 * K, vals.shape[1]), input_dtype)],
+            axis=0)
+    prec = (jax.lax.Precision.HIGHEST if input_dtype == jnp.float32
+            else jax.lax.Precision.DEFAULT)
+    fb = fb_ref[0, :]
+    iota = jax.lax.broadcasted_iota(jnp.int32, (1, WB), 1)
+    oh = (fb[:, None] == iota).astype(input_dtype)       # [Eblk, WB]
+    out_ref[0, :, :] += jnp.dot(vals, oh,
+                                preferred_element_type=jnp.float32,
+                                precision=prec)
+
+
+@functools.partial(jax.jit, static_argnames=("num_columns_padded",
+                                             "num_bins_padded",
+                                             "input_dtype", "interpret"))
+def hist_sparse_pallas(e_row: jax.Array, e_flat: jax.Array,
+                       e_valid: jax.Array, slot_col: jax.Array,
+                       zero_bin: jax.Array,
+                       lid: jax.Array, gh8: jax.Array, sl: jax.Array, *,
+                       num_columns_padded: int, num_bins_padded: int,
+                       input_dtype: str = "float32",
+                       interpret: bool = False) -> jax.Array:
+    """Pallas sparse histogram over slot-segmented entry streams
+    (sparse_window_streams).  Per-pass state (leaf ids, gradients) is
+    gathered per entry OUTSIDE the kernel — nnz-sized XLA gathers —
+    then the grid runs (windows, entry-chunks) and the per-slot
+    partial histograms fold back to columns (unscatter_slot_hist).
+    Returns [K, Cp, 3, B] f32 with the zero bin reconstructed."""
+    input_dtype = _coerce_dtype(input_dtype)
+    from jax.experimental import pallas as pl
+
+    nwin, Ew = e_row.shape
+    K = sl.shape[0]
+    Cp, B = num_columns_padded, num_bins_padded
+    W = FEATURE_GROUP
+    WB = W * B
+    Eblk = min(Ew, SPARSE_CHUNK)
+    srow = _slot_of_rows(lid, sl)
+    tot = _slot_totals(srow, gh8, K)
+    lid_e = jnp.take(lid, e_row.reshape(-1)).reshape(nwin, Ew)
+    ghm = (jnp.take(gh8[:3], e_row.reshape(-1), axis=1)
+           .reshape(3, nwin, Ew).transpose(1, 0, 2))     # [nwin, 3, Ew]
+    ghm = ghm * e_valid[:, None, :]
+    ghm = jnp.concatenate(
+        [ghm, jnp.zeros((nwin, 5, Ew), jnp.float32)], axis=1)
+    Mp = 8 * ((3 * K + 7) // 8)
+    Kp = 8 * ((K + 7) // 8)
+    sl2 = jnp.broadcast_to(jnp.pad(sl, (0, Kp - K),
+                                   constant_values=-1)[:, None], (Kp, 128))
+    dt = jnp.dtype(input_dtype)
+    grid = (nwin, Ew // Eblk)
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel_sparse, WB=WB, K=K, input_dtype=dt),
+        out_shape=jax.ShapeDtypeStruct((nwin, Mp, WB), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((Kp, 128), lambda w, k: (0, 0)),
+            pl.BlockSpec((1, Eblk), lambda w, k: (w, k)),
+            pl.BlockSpec((1, Eblk), lambda w, k: (w, k)),
+            pl.BlockSpec((1, 8, Eblk), lambda w, k: (w, 0, k)),
+        ],
+        out_specs=pl.BlockSpec((1, Mp, WB), lambda w, k: (w, 0, 0)),
+        interpret=interpret,
+    )(sl2, e_flat, lid_e, ghm)
+    # [nwin, Mp, W, B] → [nslots, Mp, B] → columns → [K, Cp, 3, B]
+    h_slots = (out.reshape(nwin, Mp, W, B).transpose(0, 2, 1, 3)
+               .reshape(nwin * W, Mp, B))
+    h = unscatter_slot_hist(h_slots, slot_col, Cp)
+    hist = jnp.stack([h[:, :K], h[:, K:2 * K], h[:, 2 * K:3 * K]],
+                     axis=2).transpose(1, 0, 2, 3)       # [K, Cp, 3, B]
+    return _apply_zero_bin(hist, tot, zero_bin)
+
+
+def hist_sparse_multileaf(sp, lid: jax.Array, gh8: jax.Array,
+                          sl: jax.Array, *, num_columns_padded: int,
+                          num_bins_padded: int, backend: str = "xla",
+                          input_dtype: str = "float32",
+                          interpret: bool = False) -> jax.Array:
+    """Dispatch over the sparse store pytree (cols, binsv, zero_bin,
+    e_row, e_flat, e_valid, slot_col): the slot-stream pallas kernel on
+    TPU, the scatter-add XLA path elsewhere (stream arrays are then
+    empty placeholders).  Same [K, F, 3, B] contract as
+    hist_multileaf_masked."""
+    cols, binsv, zero_bin, e_row, e_flat, e_valid, slot_col = sp
+    if backend == "pallas":
+        return hist_sparse_pallas(
+            e_row, e_flat, e_valid, slot_col, zero_bin, lid, gh8, sl,
+            num_columns_padded=num_columns_padded,
+            num_bins_padded=num_bins_padded, input_dtype=input_dtype,
+            interpret=interpret)
+    return hist_sparse_xla(cols, binsv, zero_bin, lid, gh8, sl,
+                           num_columns_padded=num_columns_padded,
+                           num_bins_padded=num_bins_padded)
+
+
+def hist_sparse_gathered(sp, gh8: jax.Array, perm: jax.Array,
+                         seg_off: jax.Array, seg_cnt: jax.Array, *,
+                         capacity: int, num_columns_padded: int,
+                         num_bins_padded: int):
+    """Gathered (ordered) sparse histogram: compact the K leaf-contiguous
+    row segments of the device row partition into the static scratch
+    (gather_segments — CSR row segments permute exactly like dense
+    rows), gather their ELL entries, and histogram only those.  Returns
+    ([K, Cp, 3, B] hists, f32 stored entries touched) — the nnz-scaled
+    analog of hist_multileaf_gathered, XLA path (the window streams are
+    store-order static and cannot be re-sorted per pass)."""
+    cols, binsv, zero_bin = sp[0], sp[1], sp[2]
+    K = seg_off.shape[0]
+    Cp = num_columns_padded
+    idx, slot, _ = gather_segments(perm, seg_off, seg_cnt,
+                                   capacity=capacity)
+    cg = jnp.take(cols, idx, axis=0)                     # [cap, R]
+    bg = jnp.take(binsv, idx, axis=0)
+    live = (slot >= 0)
+    # dead scratch slots: zero vals AND sentinel entries, so neither
+    # the totals nor the scatter see them
+    cg = jnp.where(live[:, None], cg, Cp)
+    ghg = jnp.take(gh8, idx, axis=1) * live[None, :].astype(jnp.float32)
+    sl = jax.lax.iota(jnp.int32, K)
+    h = hist_sparse_xla(cg, bg, zero_bin, slot, ghg, sl,
+                        num_columns_padded=Cp,
+                        num_bins_padded=num_bins_padded)
+    nnz = jnp.sum((cg < Cp).astype(jnp.float32))
+    return h, nnz
+
+
 def histogram_full_masked(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                           mask: jax.Array, *, num_bins_padded: int,
                           input_dtype: str = "float32") -> jax.Array:

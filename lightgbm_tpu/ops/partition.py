@@ -210,3 +210,33 @@ def partition_rows(bins_fn: jax.Array, leaf_id: jax.Array,
     N = leaf_id.shape[0]
     return _partition_pallas(tbl8, bins_fn, leaf_id, num_slots=S,
                              interpret=interpret)[:N]
+
+
+def partition_rows_sparse(cols: jax.Array, binsv: jax.Array,
+                          zero_bin: jax.Array, leaf_id: jax.Array,
+                          tbl: jax.Array, *, num_slots: int) -> jax.Array:
+    """partition_rows over the CSR/ELL sparse store (docs/Sparse.md).
+
+    cols/binsv [N, R] per-row (store column, bin) entries (col sentinel
+    >= C marks an empty slot); zero_bin [C] int32.  The row's bin of
+    its leaf's split column is an ELL probe — at most R compares per
+    row, nnz-scaled like the sparse histogram — falling back to the
+    column's zero bin when the row stores no entry there.  Table
+    semantics match partition_rows exactly (new-leaf 0 = stay)."""
+    tbl = _augment_tbl(tbl)
+    r = table_lookup(tbl, leaf_id, num_slots=num_slots)
+    fi = r[0].astype(jnp.int32)
+    ti = r[1].astype(jnp.int32)
+    ci = r[2] > 0
+    nli = r[3].astype(jnp.int32)
+    lo = r[4].astype(jnp.int32)
+    hi1 = r[5].astype(jnp.int32)
+    dl = r[6] > 0
+    hit = cols == fi[:, None]                            # [N, R]
+    vi = jnp.sum(jnp.where(hit, binsv, 0), axis=1)
+    C = zero_bin.shape[0]
+    zb = jnp.maximum(zero_bin[jnp.clip(fi, 0, C - 1)], 0)
+    vi = jnp.where(jnp.any(hit, axis=1), vi, zb)
+    gl = jnp.where(ci, vi == ti, vi <= ti)
+    gl = jnp.where((vi >= lo) & (vi <= hi1), gl, dl)
+    return jnp.where((nli > 0) & ~gl, nli, leaf_id)

@@ -70,6 +70,19 @@ HIST_ROWS_TOUCHED = "tree/hist_rows_touched"
 HIST_EXCHANGE_BYTES = "tree/hist_exchange_bytes"
 SPLIT_RECORDS_BYTES = "tree/split_records_bytes"
 
+# Canonical sparse-store counters (docs/Sparse.md), the nnz-scaling
+# evidence behind the sparse-vs-dense CTR A/B:
+#  - SPARSE_NNZ_TOUCHED: stored (column, bin) entries processed by the
+#    nonzero-iterating histogram kernels, summed over passes (global
+#    across shards, like HIST_ROWS_TOUCHED).  The dense equivalent is
+#    rows_touched x store columns; the ratio is the bench gate.
+#  - SPARSE_FALLBACKS: times a sparse store had to materialize its
+#    dense [F_eff, N] matrix for a consumer without a sparse path
+#    (feature-sharded learners, binned score replay, binary-cache
+#    writes) — silent densification is an operator-visible signal.
+SPARSE_NNZ_TOUCHED = "tree/sparse_nnz_touched"
+SPARSE_FALLBACKS = "tree/sparse_fallbacks"
+
 # Canonical robustness counters (docs/Robustness.md), fed through
 # count() by the serving fleet's failover machinery and the registry:
 #  - REGISTRY_SWAP_FAILURES: hot-swap candidates rejected (corrupt/torn
@@ -105,6 +118,7 @@ SERVE_BINNED_REQUESTS = "serve/binned_requests"
 # sites use the constants instead of re-typing the strings.
 CANONICAL_COUNTERS = (
     HIST_ROWS_TOUCHED, HIST_EXCHANGE_BYTES, SPLIT_RECORDS_BYTES,
+    SPARSE_NNZ_TOUCHED, SPARSE_FALLBACKS,
     REGISTRY_SWAP_FAILURES, SERVE_CHUNK_RETRIES, SERVE_REPLICA_FAILURES,
     SERVE_REPLICA_BROKEN, SERVE_REPLICA_READMITTED, SERVE_REPLICA_PROBES,
     SERVE_QUANTIZE_BYTES_IN, SERVE_BINNED_REQUESTS,

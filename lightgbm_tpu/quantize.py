@@ -118,14 +118,27 @@ def bin_column_into(k: int, values: np.ndarray,
                     store: np.ndarray) -> int:
     """Bin ONE used feature's full raw column into the store (the
     scipy-CSC column-streaming entry).  Returns realized conflicts."""
+    c = k if plan is None else int(plan.feat_col[k])
+    return bin_feature_column(k, values, mappers, used_features, plan,
+                              store[c])
+
+
+def bin_feature_column(k: int, values: np.ndarray,
+                       mappers: Sequence[BinMapper],
+                       used_features: Sequence[int], plan,
+                       out: np.ndarray) -> int:
+    """Bin ONE used feature's raw column into the [N] scratch row `out`
+    of its own store column — bin_column_into with the destination row
+    supplied by the caller, so the sparse CSR construction can fill a
+    per-column scratch without allocating the dense store.  EFB
+    last-writer-wins packing semantics are identical to the dense
+    route.  Returns realized bundle conflicts."""
     b = mappers[used_features[k]].value_to_bin(values)
     if plan is None or not plan.feat_packed[k]:
-        c = k if plan is None else int(plan.feat_col[k])
-        store[c, :] = b.astype(store.dtype)
+        out[:] = b.astype(out.dtype)
         return 0
     return pack_bundle_column(
-        b, int(plan.feat_default[k]), int(plan.feat_offset[k]),
-        store[int(plan.feat_col[k])])
+        b, int(plan.feat_default[k]), int(plan.feat_offset[k]), out)
 
 
 # ----------------------------------------------------------------------
@@ -372,8 +385,13 @@ def load_refbin(path: str, expected_sha1: Optional[str] = None):
             f"{path} is not a lightgbm_tpu refbin sidecar")
     npz = np.load(bio, allow_pickle=False)
     d = {k: npz[k] for k in npz.files}
+    # sparse_store pinned dense: a refbin is a mapper-set contract —
+    # serving consumers read its mappers/plan, never histogram it, so
+    # re-deriving a CSR store (then densifying on first .bins read)
+    # would be pure hot-swap-path churn
     cfg = Config(max_bin=int(d["max_bin"]),
-                 enable_bundle=bool(int(d["enable_bundle"])), verbose=-1)
+                 enable_bundle=bool(int(d["enable_bundle"])),
+                 sparse_store="dense", verbose=-1)
     return Dataset._from_binary_dict(d, cfg, path)
 
 

@@ -333,10 +333,31 @@ class SketchSet:
     # -- mappers ---------------------------------------------------------
 
     def mappers(self, max_bin: int, min_data_in_bin: int,
-                min_split_data: int) -> List[BinMapper]:
+                min_split_data: int, bin_budget: int = 0
+                ) -> List[BinMapper]:
         """Derive the BinMappers — the exact find_bin greedy run on the
         (merged) summaries, zero injected from the row count exactly
-        like binning._distinct_with_zero."""
+        like binning._distinct_with_zero.  ``bin_budget > 0`` applies
+        the adaptive per-feature allocation (binning.
+        allocate_bin_budgets) with distinct/mass counts read off the
+        summaries themselves — the sketch-side analog of the exact
+        sample path's column stats."""
+        budgets = None
+        if bin_budget > 0 and self.sketches:
+            from ..binning import allocate_bin_budgets
+            total0 = int(self.n_rows)
+            d = []
+            m = []
+            for sk in self.sketches:
+                nz = int(np.rint(np.asarray(sk.counts)).sum())
+                dd = int(np.asarray(sk.vals).size)
+                if nz < total0:
+                    dd += 1                       # the implied zero
+                d.append(max(dd, 1))
+                m.append(nz)
+            budgets = allocate_bin_budgets(np.asarray(d, np.int64),
+                                           np.asarray(m, np.int64),
+                                           bin_budget)
         out = []
         total = int(self.n_rows)
         for j, sk in enumerate(self.sketches):
@@ -360,14 +381,16 @@ class SketchSet:
                     pos = int(np.searchsorted(vals, 0.0))
                     vals = np.insert(vals, pos, 0.0)
                     counts = np.insert(counts, pos, zero_cnt)
+            mb = int(budgets[j]) if budgets is not None else max_bin
             out.append(find_bin_from_distinct(
-                vals, counts, total, max_bin, min_data_in_bin,
+                vals, counts, total, mb, min_data_in_bin,
                 min_split_data, bt))
         return out
 
     def mappers_from_config(self, cfg) -> List[BinMapper]:
         return self.mappers(cfg.max_bin, cfg.min_data_in_bin,
-                            cfg.min_data_in_leaf)
+                            cfg.min_data_in_leaf,
+                            bin_budget=int(getattr(cfg, "bin_budget", 0)))
 
     # -- wire format -----------------------------------------------------
 

@@ -32,8 +32,9 @@ sys.path.insert(0, ROOT)
 # Config fields are otherwise required to be consumed somewhere.
 ALLOWLIST = {
     # reference-compat parameters with no TPU analog
-    "is_enable_sparse": "no sparse store on TPU (SURVEY.md §7 start dense)",
-    "sparse_threshold": "no sparse store on TPU",
+    # (is_enable_sparse / sparse_threshold left this list in PR 14:
+    # both now gate the CSR sparse store's auto resolution,
+    # dataset.resolve_sparse_store)
     "gpu_platform_id": "OpenCL selector kept for config compatibility",
     "gpu_device_id": "OpenCL selector kept for config compatibility",
     "gpu_use_dp": "OpenCL precision dial; histogram_dtype is the analog",

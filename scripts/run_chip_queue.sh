@@ -75,6 +75,15 @@ stage bench_chaos env BENCH_SANITIZE=1 BENCH_CHAOS_OUT=bench_chaos_measured.json
 stage bench_ingest env BENCH_SANITIZE=1 BENCH_INGEST_OUT=bench_ingest_measured.json python scripts/bench_ingest.py || exit 1
 stage bench_narrow_off env LGBT_NARROW_ONEHOT=0 BENCH_ITERS=12 python bench.py || exit 1
 stage bench_part_off   env LGBT_FUSED_PARTITION=0 BENCH_ITERS=12 python bench.py || exit 1
+# wide-sparse CTR workload (docs/Sparse.md): dense-vs-csr store +
+# adaptive-bin-budget A/B refreshes the committed artifact at the real
+# >= 50k-feature acceptance shape, then one sanitized csr run gates
+# 0 retraces / 0 implicit transfers on the nonzero-iterating path
+stage bench_ctr_ab python scripts/run_ctr_ab.py || exit 1
+# csr run at the full 50k-feature shape: EFB planner off (its [F, S]
+# conflict sample is a host hazard at 50k sparse features) and 63 bins
+# so the [K, 50k, 3, B] reduced histogram fits one chip
+stage bench_ctr env BENCH_WORKLOAD=ctr BENCH_SANITIZE=1 BENCH_SPARSE_STORE=csr BENCH_ENABLE_BUNDLE=0 BENCH_ROWS=500000 BENCH_BINS=63 BENCH_LEAVES=31 BENCH_ITERS=12 python bench.py || exit 1
 # 2. the 63-bin variant (VERDICT #2: reference accelerator sweet spot)
 stage bench_63bin      env BENCH_BINS=63 BENCH_ITERS=12 python bench.py || exit 1
 # 3. full 500-iter north-star refreshes at HEAD
