@@ -219,9 +219,15 @@ class Application:
     def _online(self) -> None:
         """task=online: the continuous refresh daemon (online/trainer.py)
         — watch a labeled-traffic JSONL, refit/continue on trigger,
-        publish generations to the registry path."""
-        from .online.trainer import OnlineTrainer
-        OnlineTrainer.from_config(self.config).run_forever()
+        publish generations to the registry path.  With `serve_models`
+        set, one daemon per catalog tenant shares the traffic tail
+        (keyed rows, keyed publish paths — docs/serving.md
+        "Multi-tenant catalog")."""
+        from .online.trainer import OnlineFleet, OnlineTrainer
+        if self.config.serve_models:
+            OnlineFleet.from_config(self.config).run_forever()
+        else:
+            OnlineTrainer.from_config(self.config).run_forever()
 
     # ------------------------------------------------------------------
     def _refit(self) -> None:

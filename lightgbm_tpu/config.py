@@ -11,6 +11,7 @@ argument.
 from __future__ import annotations
 
 import dataclasses
+import re
 from typing import Any, Dict, List, Optional, Tuple
 
 # the predict_kernel dial's legal values — defined here (stdlib-only
@@ -24,6 +25,44 @@ PREDICT_KERNELS = ("auto", "tensorized", "walk")
 # sidecar, "raw" keeps f32 feature traversal, "auto" picks binned
 # whenever a valid sidecar is present
 SERVE_QUANTIZE_MODES = ("auto", "binned", "raw")
+
+# tenant ids of the multi-tenant serving catalog (`serve_models`
+# entries, /predict `model` routing).  The charset is deliberately
+# tight: ids are echoed into HTTP headers, Prometheus label values,
+# telemetry attrs, and traffic-log records, so identifier-shaped ids
+# need no escaping at any of those hops.
+MODEL_ID_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
+
+
+def parse_serve_models(entries) -> Dict[str, str]:
+    """``("de=/models/de.txt", "fr=/models/fr.txt")`` → ordered
+    ``{id: model path}``.  The ONE place the `serve_models` grammar
+    lives — config validation, `task=serve` catalog construction, and
+    the `task=online` per-tenant daemon fleet all route through here.
+    Raises ValueError on a missing ``=``, an id outside MODEL_ID_RE,
+    an empty path, or a duplicate id."""
+    out: Dict[str, str] = {}
+    for entry in entries:
+        mid, sep, path = str(entry).partition("=")
+        mid, path = mid.strip(), path.strip()
+        if not sep or not path:
+            raise ValueError(
+                f"serve_models entry {entry!r} is not 'id=path'")
+        if not MODEL_ID_RE.match(mid):
+            raise ValueError(
+                f"serve_models id {mid!r} must match "
+                "[A-Za-z0-9._-]{1,64}")
+        if mid in out:
+            raise ValueError(f"serve_models id {mid!r} appears twice")
+        if path in out.values():
+            # two tenants on one file would share publish/state/refbin
+            # sidecars: their online daemons would clobber each other's
+            # publishes and resume offsets
+            raise ValueError(
+                f"serve_models path {path!r} appears under two ids")
+        out[mid] = path
+    return out
+
 
 # the sparse_store dial's legal values — binned-store layout
 # (docs/Sparse.md): "csr" keeps per-row (store column, bin) nonzero
@@ -137,6 +176,18 @@ PARAM_ALIASES: Dict[str, str] = {
     "predict_engine": "predict_kernel",
     "serving_quantize": "serve_quantize",
     "quantized_serving": "serve_quantize",
+    # multi-tenant serving catalog (docs/serving.md "Multi-tenant
+    # catalog", lightgbm_tpu/serving/catalog.py)
+    "serving_models": "serve_models",
+    "model_catalog": "serve_models",
+    "serve_cache_budget": "serve_cache_budget_mb",
+    "cache_budget_mb": "serve_cache_budget_mb",
+    "shadow_fraction": "serve_shadow_fraction",
+    "canary_fraction": "serve_shadow_fraction",
+    "shadow_requests": "serve_shadow_requests",
+    "canary_requests": "serve_shadow_requests",
+    "shadow_max_divergence": "serve_shadow_max_divergence",
+    "canary_max_divergence": "serve_shadow_max_divergence",
     # online learning (task=online / task=refit, lightgbm_tpu/online/)
     "decay_rate": "refit_decay_rate",
     "refit_decay": "refit_decay_rate",
@@ -470,6 +521,34 @@ class Config:
     # whenever a valid sidecar is present and falls back to raw
     # otherwise.
     serve_quantize: str = "auto"
+    # multi-tenant catalog (docs/serving.md "Multi-tenant catalog"):
+    # `id=path` entries, one independent model per tenant id — requests
+    # route by the `model` field/query param/X-Model-Id header, each
+    # tenant gets its own registry, batcher (admission budget), replica
+    # breakers, and /stats / /metrics accounting.  Empty = single-model
+    # serving with `input_model` as the default tenant; with entries,
+    # `input_model` (when set) still serves requests that name no model.
+    # Also consumed by task=online: one refresh daemon per entry, each
+    # filtering the shared traffic log by its tenant id and publishing
+    # to its own path.
+    serve_models: Tuple[str, ...] = tuple()
+    # device-memory budget (MiB) for the catalog's compiled-executable
+    # caches across ALL tenants: beyond it, the least-recently-used
+    # tenants' executables are evicted (their next request recompiles —
+    # serve/cache_evictions counts the churn).  The most recently used
+    # tenant is never evicted.  0 = unlimited.
+    serve_cache_budget_mb: int = 0
+    # shadow-canary publishes: with a fraction > 0, a republished model
+    # is STAGED as a candidate instead of swapped live — this fraction
+    # of requests is double-scored on it (stable still answers the
+    # client), per-request divergence is logged, and the candidate is
+    # adopted only after `serve_shadow_requests` comparisons (rejected
+    # if any divergence exceeds `serve_shadow_max_divergence`, when
+    # >= 0; < 0 = log-only, always adopt).  0 = swap immediately (the
+    # pre-catalog behavior).
+    serve_shadow_fraction: float = 0.0
+    serve_shadow_requests: int = 32
+    serve_shadow_max_divergence: float = -1.0
 
     # -- fault tolerance (task=train checkpoint/resume, docs/Robustness.md)
     # when set, training snapshots (model + iteration + early-stopping +
@@ -533,7 +612,7 @@ class Config:
 _FIELD_TYPES = {f.name: f.type for f in dataclasses.fields(Config)}
 _TUPLE_INT_FIELDS = {"ndcg_eval_at", "mesh_shape"}
 _TUPLE_FLOAT_FIELDS = {"label_gain"}
-_TUPLE_STR_FIELDS = {"valid_data", "metric"}
+_TUPLE_STR_FIELDS = {"valid_data", "metric", "serve_models"}
 
 
 def apply_aliases(params: Dict[str, Any]) -> Dict[str, Any]:
@@ -661,6 +740,15 @@ def check_param_conflict(cfg: Config) -> None:
     if cfg.serve_quantize not in SERVE_QUANTIZE_MODES:
         raise ValueError(f"unknown serve_quantize: {cfg.serve_quantize}; "
                          f"use one of {SERVE_QUANTIZE_MODES}")
+    if cfg.serve_models:
+        parse_serve_models(cfg.serve_models)   # id=path shape + id charset
+    if cfg.serve_cache_budget_mb < 0:
+        raise ValueError("serve_cache_budget_mb must be >= 0 "
+                         "(0 = unlimited)")
+    if not (0.0 <= cfg.serve_shadow_fraction <= 1.0):
+        raise ValueError("serve_shadow_fraction must be in [0, 1]")
+    if cfg.serve_shadow_requests < 1:
+        raise ValueError("serve_shadow_requests must be >= 1")
     if not (0.0 <= cfg.refit_decay_rate <= 1.0):
         raise ValueError("refit_decay_rate must be in [0, 1]")
     if cfg.refit_min_rows < 0:

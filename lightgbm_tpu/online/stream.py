@@ -5,6 +5,7 @@ Line format (one example per line):
 
     {"features": [f0, f1, ...], "label": y}
     {"features": [f0, f1, ...], "label": y, "weight": w}
+    {"features": [...], "label": y, "model": "de"}   # catalog tenant
     [y, f0, f1, ...]                      # plain-array shorthand
 
 which is exactly the serving request body's row shape
@@ -28,7 +29,7 @@ import numpy as np
 
 def append_traffic(path: str, X: np.ndarray, y: np.ndarray,
                    weight: Optional[np.ndarray] = None,
-                   trace_ids=None) -> int:
+                   trace_ids=None, model_id: Optional[str] = None) -> int:
     """Append labeled rows to a traffic log (the writer half — what a
     serving-side label joiner produces); returns rows written.
 
@@ -36,7 +37,11 @@ def append_traffic(path: str, X: np.ndarray, y: np.ndarray,
     entries allowed) stamps each record with the serving-side trace id
     of the /predict request that scored it — the hop that lets the
     online daemon's publish sidecar name the originating requests
-    (docs/Observability.md propagation diagram)."""
+    (docs/Observability.md propagation diagram).  ``model_id`` keys
+    each record with the catalog tenant that served it, so N per-tenant
+    daemons can share ONE traffic tail (each reads only its own rows —
+    TrafficLog ``model_filter``); None keeps the unkeyed single-tenant
+    record shape."""
     from ..diagnostics import faults
     X = np.asarray(X, np.float64)
     if X.ndim == 1:
@@ -52,6 +57,8 @@ def append_traffic(path: str, X: np.ndarray, y: np.ndarray,
         for i in range(len(X)):
             rec = {"features": [float(v) for v in X[i]],
                    "label": float(y[i])}
+            if model_id is not None:
+                rec["model"] = str(model_id)
             if weight is not None:
                 rec["weight"] = float(np.asarray(weight).reshape(-1)[i])
             if trace_ids is not None and trace_ids[i]:
@@ -76,15 +83,33 @@ class TrafficLog:
     Either way the reference persists across polls, so one short-but-
     parseable line can only lose itself — never become the yardstick
     that rejects every valid row behind it.
+
+    `model_filter` keys the reader to ONE catalog tenant of a shared
+    multi-tenant log: rows whose ``model`` field names another tenant
+    are skipped (counted in ``filtered_rows`` — they are another
+    daemon's data, not loss); rows with NO model field match only when
+    `match_unkeyed` is true (the default tenant's daemon sets it, so
+    pre-catalog writers keep feeding it).  No filter = read everything,
+    the single-tenant behavior.
     """
 
     def __init__(self, path: str, expected_features: Optional[int] = None,
-                 max_poll_bytes: int = 64 << 20):
+                 max_poll_bytes: int = 64 << 20,
+                 model_filter: Optional[str] = None,
+                 match_unkeyed: Optional[bool] = None):
         self.path = path
         self.offset = 0           # byte offset of the first unread line
         self.rows_read = 0
         self.bad_lines = 0
         self.overcap_skips = 0    # single lines larger than max_poll_bytes
+        self.filtered_rows = 0    # other tenants' rows (not data loss)
+        self._model_filter = (str(model_filter)
+                              if model_filter is not None else None)
+        # unfiltered readers take every row incl. unkeyed ones; a
+        # keyed reader skips unkeyed rows unless told otherwise
+        self._match_unkeyed = (model_filter is None
+                               if match_unkeyed is None
+                               else bool(match_unkeyed))
         self._width = (int(expected_features)
                        if expected_features else None)
         # per-poll read cap: a daemon (re)started against a multi-GB
@@ -99,10 +124,11 @@ class TrafficLog:
     def counters(self) -> dict:
         """Silent-data-loss evidence for /stats (docs/Robustness.md):
         rows consumed, malformed lines skipped, over-cap lines skipped,
-        and the current byte offset."""
+        other-tenant rows filtered, and the current byte offset."""
         return {"offset": int(self.offset), "rows_read": int(self.rows_read),
                 "bad_lines": int(self.bad_lines),
-                "overcap_skips": int(self.overcap_skips)}
+                "overcap_skips": int(self.overcap_skips),
+                "filtered_rows": int(self.filtered_rows)}
 
     def seek(self, offset: int, counters: Optional[dict] = None) -> None:
         """Restore a persisted read position (daemon restart): the next
@@ -113,6 +139,8 @@ class TrafficLog:
             self.bad_lines = int(counters.get("bad_lines", self.bad_lines))
             self.overcap_skips = int(counters.get("overcap_skips",
                                                   self.overcap_skips))
+            self.filtered_rows = int(counters.get("filtered_rows",
+                                                  self.filtered_rows))
 
     def read_new(self) -> Optional[Tuple[np.ndarray, np.ndarray,
                                          Optional[np.ndarray]]]:
@@ -154,17 +182,29 @@ class TrafficLog:
             try:
                 item = json.loads(line)
                 if isinstance(item, dict):
+                    rec_model = item.get("model")
                     row = [float(v) for v in item["features"]]
                     lab = float(item["label"])
                     w = item.get("weight")
                     tr = item.get("trace_id")
                 else:               # [label, f0, f1, ...] shorthand
+                    rec_model = None
                     lab = float(item[0])
                     row = [float(v) for v in item[1:]]
                     w = None
                     tr = None
             except (ValueError, TypeError, KeyError, IndexError):
                 self.bad_lines += 1
+                continue
+            # tenant keying: another tenant's (well-formed) row is
+            # filtered, not "bad" — it is some other daemon's data
+            if rec_model is None:
+                if not self._match_unkeyed:
+                    self.filtered_rows += 1
+                    continue
+            elif (self._model_filter is not None
+                    and str(rec_model) != self._model_filter):
+                self.filtered_rows += 1
                 continue
             if self._width is None:
                 self._width = len(row)

@@ -87,17 +87,26 @@ class OnlineTrainer:
 
     def __init__(self, booster, traffic_path: str, publish_path: str, *,
                  config: Optional[Config] = None, reference=None,
-                 resume: bool = True):
+                 resume: bool = True, model_id: Optional[str] = None,
+                 match_unkeyed: Optional[bool] = None):
         cfg = config or config_from_params(booster.params)
         if not booster._gbdt.models:
             raise LightGBMError("task=online needs a trained input model")
         self.cfg = cfg
         self.booster = booster
+        # catalog tenant id (multi-tenant serving, docs/serving.md
+        # "Multi-tenant catalog"): keys this daemon to its own rows of
+        # a SHARED traffic tail and stamps the publish sidecar, so the
+        # serving catalog's per-tenant poll picks up exactly this
+        # tenant's refreshes.  None = the unkeyed single-tenant daemon.
+        self.model_id = model_id
         # pin the traffic row width to the model's feature count so a
         # single malformed-width line can never become the yardstick
         # that rejects the valid rows behind it
         self.traffic = TrafficLog(traffic_path,
-                                  expected_features=booster.num_feature())
+                                  expected_features=booster.num_feature(),
+                                  model_filter=model_id,
+                                  match_unkeyed=match_unkeyed)
         self.publish_path = publish_path
         self.state_path = publish_path + ".state.json"
         self.refbin_path = publish_path + ".refbin"
@@ -444,11 +453,13 @@ class OnlineTrainer:
         # ONE trace id spans the whole refresh — refit/continue,
         # publish, and (via the meta sidecar) the serving registry's
         # hot-swap adopt it, so the train half of the serve→train→serve
-        # loop is a single grep
+        # loop is a single grep (per tenant: the model attr keys it)
         with telemetry.span("online.refresh", mode=self.mode,
                             rows=int(window.num_data),
                             generation=self.generation + 1,
-                            origin_traces=len(self._window_traces)):
+                            origin_traces=len(self._window_traces),
+                            **({"model": self.model_id}
+                               if self.model_id is not None else {})):
             t0 = time.perf_counter()
             if self.mode == "continue":
                 with telemetry.span("online.continue"):
@@ -515,6 +526,9 @@ class OnlineTrainer:
         tmp = f"{self.publish_path}.g{gen}.tmp"
         self.booster.save_model(tmp)
         meta = {"generation": gen, "mode": self.mode,
+                # catalog tenant provenance: which tenant's daemon
+                # published this generation (None outside the catalog)
+                "model_id": self.model_id,
                 "refreshes": self.refreshes + 1,
                 "rows_seen": int(self.rows_seen),
                 "trigger_rows": self.trigger,
@@ -574,6 +588,23 @@ class OnlineTrainer:
                  f"({self.mode}, {stats.get('rows', 0)} rows) to "
                  f"{self.publish_path}")
 
+    def _guarded_poll(self) -> None:
+        """One poll that can never kill the daemon on a bad window —
+        except an injected CRASH, which is a crash (no drain, no state
+        flush: chaos runs must exercise the cold restart)."""
+        try:
+            self.poll_once()
+        except faults.InjectedFault:
+            raise
+        except Exception as e:      # never kill the daemon on one window
+            self._record_refresh(ok=False,
+                                 error=f"{type(e).__name__}: {e}")
+            log.warning(f"online refresh failed: {e}")
+            try:
+                self._flush_state()   # the failure is /stats-visible
+            except OSError:
+                pass
+
     def run_forever(self, poll_seconds: Optional[float] = None,
                     stop: Optional[threading.Event] = None) -> None:
         """Blocking poll loop; `stop` lets tests (and signal handlers)
@@ -582,40 +613,146 @@ class OnlineTrainer:
         state sidecar flushes so the NEXT daemon resumes exactly here."""
         period = (self.cfg.model_poll_seconds if poll_seconds is None
                   else float(poll_seconds)) or 1.0
-        stop = stop or threading.Event()
-        try:                           # main thread only; tests use `stop`
-            signal.signal(signal.SIGTERM, lambda *_: stop.set())
-        except (ValueError, OSError):
-            pass
         log.info(f"online: watching {self.traffic.path} every "
                  f"{period:g}s (mode={self.mode}, trigger="
                  f"{self.trigger} rows, publishing to "
                  f"{self.publish_path})")
-        while not stop.wait(period):
+
+        def flush_all():
             try:
-                self.poll_once()
+                self._flush_state()
+            except OSError as e:
+                log.warning(f"online: final state flush failed: {e}")
+
+        _run_daemon_loop(period, stop, self._guarded_poll, flush_all,
+                         "online: stopped (state flushed to "
+                         f"{self.state_path})")
+
+
+def _run_daemon_loop(period: float, stop: Optional[threading.Event],
+                     poll, flush_all, stopped_msg: str) -> None:
+    """The poll/drain/flush lifecycle shared by the single daemon and
+    the fleet: SIGTERM (main thread only; tests pass `stop`) ends the
+    loop, ONE final drain poll ingests whatever already reached the
+    log — an InjectedFault during it propagates WITHOUT the final
+    flush (chaos runs exercise the cold restart) — then every state
+    sidecar flushes so the next daemon resumes exactly here."""
+    stop = stop or threading.Event()
+    try:
+        signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    except (ValueError, OSError):
+        pass
+    while not stop.wait(period):
+        poll()
+    try:                            # drain: SIGTERM/stop arrived
+        poll()
+    except faults.InjectedFault:
+        raise
+    flush_all()
+    log.info(stopped_msg)
+
+
+class OnlineFleet:
+    """One `OnlineTrainer` per catalog tenant, sharing ONE traffic tail.
+
+    `serve_models` (the same ``id=path`` entries the serving catalog
+    uses) drives ``task=online`` into fleet mode: each tenant's daemon
+    tails the SAME labeled-traffic file but ingests only its own keyed
+    rows (TrafficLog ``model_filter``; unkeyed rows feed the
+    ``default`` entry, or the first entry when none is named
+    ``default``), refreshes the model AT its tenant's path, and
+    publishes back to that path — which is exactly what the serving
+    catalog polls per tenant.  State/refbin sidecars key off each
+    publish path, so crash-safe resume stays per-tenant.  One tenant's
+    refresh failure never stalls the others.
+
+    Known limit (ROADMAP item 2): each tenant's TrafficLog parses the
+    WHOLE shared tail independently — poll cost scales with tenants x
+    log bytes.  A single demuxing reader feeding per-tenant buffers is
+    the follow-on once tenant counts grow past a handful.
+    """
+
+    def __init__(self, trainers: List[OnlineTrainer]):
+        if not trainers:
+            raise LightGBMError("OnlineFleet needs at least one trainer")
+        self.trainers = list(trainers)
+
+    @classmethod
+    def from_config(cls, cfg: Config) -> "OnlineFleet":
+        from ..basic import Booster
+        from ..serving.server import catalog_models_from_config
+        if not cfg.data:
+            raise LightGBMError(
+                "task=online needs data=<labeled traffic .jsonl>")
+        # the SAME id→path map the serving catalog builds — including
+        # `input_model` as the `default` tenant: the serving side keys
+        # unnamed requests (and their traffic rows) "default", so a
+        # fleet without that daemon would silently filter every
+        # default-keyed row and let the default model go stale
+        models = catalog_models_from_config(cfg)
+        unkeyed_owner = ("default" if "default" in models
+                         else next(iter(models)))
+        trainers = []
+        for mid, path in models.items():
+            # each tenant's model path is both the daemon's input and
+            # its publish target: the daemon refreshes the published
+            # file in place (atomic os.replace), the catalog's
+            # per-tenant poll picks it up
+            tcfg = cfg.with_updates(input_model=path, output_model=path)
+            booster = Booster(params=_booster_params(tcfg),
+                              model_file=path)
+            trainers.append(OnlineTrainer(
+                booster, cfg.data, path, config=tcfg, model_id=mid,
+                match_unkeyed=(mid == unkeyed_owner)))
+        log.info(f"online fleet: {len(trainers)} tenant daemons "
+                 f"({', '.join(models)}) sharing {cfg.data}")
+        return cls(trainers)
+
+    def pending_rows(self) -> int:
+        return sum(t.pending_rows() for t in self.trainers)
+
+    def poll_once(self) -> int:
+        """Poll every tenant once; returns generations published."""
+        published = 0
+        for t in self.trainers:
+            try:
+                if t.poll_once():
+                    published += 1
             except faults.InjectedFault:
-                raise               # an injected CRASH is a crash: no
-                                    # drain, no state flush (chaos runs
-                                    # must exercise the cold restart)
-            except Exception as e:  # never kill the daemon on one window
-                self._record_refresh(
-                    ok=False, error=f"{type(e).__name__}: {e}")
-                log.warning(f"online refresh failed: {e}")
+                raise               # chaos runs exercise the cold restart
+            except Exception as e:  # isolate: tenant A's bad window
+                # must not stall tenant B's refreshes
+                t._record_refresh(ok=False,
+                                  error=f"{type(e).__name__}: {e}")
+                log.warning(f"online refresh failed for "
+                            f"{t.model_id}: {e}")
                 try:
-                    self._flush_state()   # the failure is /stats-visible
+                    t._flush_state()
                 except OSError:
                     pass
-        try:                        # drain: SIGTERM/stop arrived
-            self.poll_once()
-        except faults.InjectedFault:
-            raise
-        except Exception as e:
-            self._record_refresh(ok=False,
-                                 error=f"{type(e).__name__}: {e}")
-        try:
-            self._flush_state()
-        except OSError as e:
-            log.warning(f"online: final state flush failed: {e}")
-        log.info("online: stopped (state flushed to "
-                 f"{self.state_path})")
+        return published
+
+    def run_forever(self, poll_seconds: Optional[float] = None,
+                    stop: Optional[threading.Event] = None) -> None:
+        """Blocking fleet loop — the multi-tenant ``task=online``
+        entry; SIGTERM drains every tenant and flushes every state
+        sidecar (the same `_run_daemon_loop` discipline as the single
+        daemon; per-tenant failures are already isolated in
+        poll_once)."""
+        period = (self.cfg_poll if poll_seconds is None
+                  else float(poll_seconds)) or 1.0
+
+        def flush_all():
+            for t in self.trainers:
+                try:
+                    t._flush_state()
+                except OSError as e:
+                    log.warning(f"online fleet: state flush failed for "
+                                f"{t.model_id}: {e}")
+
+        _run_daemon_loop(period, stop, self.poll_once, flush_all,
+                         "online fleet: stopped")
+
+    @property
+    def cfg_poll(self) -> float:
+        return self.trainers[0].cfg.model_poll_seconds

@@ -111,6 +111,24 @@ SERVE_REPLICA_PROBES = "serve.replica_probes"
 SERVE_QUANTIZE_BYTES_IN = "serve/quantize_bytes_in"
 SERVE_BINNED_REQUESTS = "serve/binned_requests"
 
+# Canonical multi-tenant catalog counters (docs/serving.md
+# "Multi-tenant catalog"), fed through count() by the ModelCatalog's
+# LRU budget enforcement and the registries' shadow-canary machinery:
+#  - SERVE_CACHE_EVICTIONS: compiled executables dropped to fit the
+#    `serve_cache_budget_mb` device-memory budget (the churn metric —
+#    an evicted tenant's next request recompiles).
+#  - SERVE_SHADOW_SCORED: requests double-scored on a staged candidate
+#    generation (stable answered the client; the candidate's answer
+#    only fed the divergence log).
+#  - SERVE_SHADOW_ADOPTIONS / SERVE_SHADOW_REJECTIONS: canary verdicts
+#    — candidates promoted to stable after `serve_shadow_requests`
+#    comparisons vs candidates discarded (divergence over the gate, or
+#    a candidate that could not score).
+SERVE_CACHE_EVICTIONS = "serve/cache_evictions"
+SERVE_SHADOW_SCORED = "serve/shadow_scored"
+SERVE_SHADOW_ADOPTIONS = "serve/shadow_adoptions"
+SERVE_SHADOW_REJECTIONS = "serve/shadow_rejections"
+
 # Every canonical counter constant of this module, in one tuple: the
 # Prometheus exposition (telemetry.prometheus_text) seeds each of these
 # at 0 so a scrape always covers the full canonical set, and the
@@ -122,7 +140,28 @@ CANONICAL_COUNTERS = (
     REGISTRY_SWAP_FAILURES, SERVE_CHUNK_RETRIES, SERVE_REPLICA_FAILURES,
     SERVE_REPLICA_BROKEN, SERVE_REPLICA_READMITTED, SERVE_REPLICA_PROBES,
     SERVE_QUANTIZE_BYTES_IN, SERVE_BINNED_REQUESTS,
+    SERVE_CACHE_EVICTIONS, SERVE_SHADOW_SCORED, SERVE_SHADOW_ADOPTIONS,
+    SERVE_SHADOW_REJECTIONS,
 )
+
+
+def labeled(name: str, **labels) -> str:
+    """Registry key for a LABELED counter/reservoir series.
+
+    ``labeled("serve.requests", model="de")`` returns
+    ``serve.requests{model="de"}``, which `telemetry.prometheus_text`
+    renders as the Prometheus series
+    ``lgbt_serve_requests_total{model="de"}`` — one metric FAMILY with
+    one series per label set, instead of a name-mangled counter per
+    tenant.  Label values must be identifier-shaped (the multi-tenant
+    catalog validates model ids against ``[A-Za-z0-9._-]{1,64}`` before
+    they reach here); the base name follows the same rules as unlabeled
+    counters (scripts/check_counter_names.py lints `labeled` call sites
+    like any other registry call)."""
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+    return f"{name}{{{inner}}}"
 
 
 @contextmanager

@@ -14,18 +14,27 @@ Four layers, composed bottom-up:
   replica, optional admission control (`max_pending_rows` → 503).
 - `registry` — ModelRegistry: versioned atomic hot-swap (mtime poll or
   SIGHUP) with pre-swap warmup of every traffic bucket for BOTH output
-  kinds, and rollback on a bad model.
+  kinds, rollback on a bad model, and optional shadow-canary staging
+  (`serve_shadow_fraction`: double-score a weighted fraction of live
+  traffic on a staged candidate, log divergence, adopt or reject).
+- `catalog`  — ModelCatalog: N keyed tenants (model id → registry +
+  batcher) on one fleet — per-model routing/SLO accounting/admission
+  budgets, LRU compiled-executable eviction under
+  `serve_cache_budget_mb`, cross-tenant fault isolation.
 - `server`   — PredictionServer: stdlib JSON-lines HTTP endpoint
-  (/predict, /healthz, /stats), the `task=serve` CLI entry.
+  (/predict with `model` routing, /healthz, /stats, /metrics), the
+  `task=serve` CLI entry.
 """
 from .runtime import (OUTPUT_KINDS, PredictorRuntime,
                       resolve_serve_replicas, row_bucket)
 from .batcher import MicroBatcher, ServerOverloadedError
 from .registry import ModelRegistry
+from .catalog import DEFAULT_MODEL_ID, ModelCatalog, UnknownModelError
 from .server import PredictionServer, serve_from_config, server_from_config
 
 __all__ = [
     "OUTPUT_KINDS", "PredictorRuntime", "resolve_serve_replicas",
     "row_bucket", "MicroBatcher", "ServerOverloadedError", "ModelRegistry",
+    "DEFAULT_MODEL_ID", "ModelCatalog", "UnknownModelError",
     "PredictionServer", "serve_from_config", "server_from_config",
 ]

@@ -35,7 +35,7 @@ from typing import Deque, List, Optional
 
 import numpy as np
 
-from .. import profiling, telemetry
+from .. import log, profiling, telemetry
 from ..log import LightGBMError
 
 # monotonic clock for ALL deadline math — module-level and injectable so
@@ -77,12 +77,21 @@ class MicroBatcher:
 
     def __init__(self, source, *, max_batch_rows: int = 4096,
                  flush_deadline_ms: float = 5.0, workers: int = 1,
-                 max_pending_rows: int = 0):
+                 max_pending_rows: int = 0,
+                 model_id: Optional[str] = None):
         self._source = source
         self.max_batch_rows = max(1, int(max_batch_rows))
         self.flush_deadline_s = max(0.0, float(flush_deadline_ms)) / 1e3
         self.max_pending_rows = max(0, int(max_pending_rows))
         self.workers = max(1, int(workers))
+        # catalog tenant id: when set, every fleet-wide counter this
+        # batcher bumps also bumps its per-model labeled series (the
+        # /metrics `{model="..."}` accounting), and max_pending_rows is
+        # this tenant's OWN admission budget — one hot tenant sheds its
+        # own load instead of starving the fleet
+        self.model_id = model_id
+        self._labels = ({"model": model_id} if model_id is not None
+                        else None)
         self._cond = threading.Condition()
         self._queue: Deque[_Request] = deque()
         self._rows_pending = 0
@@ -124,15 +133,28 @@ class MicroBatcher:
                     and self._rows_pending >= self.max_pending_rows):
                 self.rejected += 1
                 profiling.count("serve.rejected")
+                if self._labels:
+                    profiling.count(profiling.labeled("serve.rejected",
+                                                      **self._labels))
                 raise ServerOverloadedError(
                     f"serving queue full ({self._rows_pending} rows "
-                    f"pending, cap {self.max_pending_rows}); retry later")
+                    f"pending, cap {self.max_pending_rows}"
+                    + (f", model {self.model_id}" if self.model_id
+                       else "") + "); retry later")
             self._queue.append(req)
             self._rows_pending += X.shape[0]
             depth = len(self._queue)
             self._cond.notify_all()
         profiling.count("serve.requests")
         profiling.observe("serve.queue_depth", depth)
+        if self._labels:
+            profiling.count(profiling.labeled("serve.requests",
+                                              **self._labels))
+            profiling.count(profiling.labeled("serve.rows",
+                                              **self._labels),
+                            X.shape[0])
+            profiling.observe(profiling.labeled("serve.queue_depth",
+                                                **self._labels), depth)
         return req.future
 
     @property
@@ -241,6 +263,10 @@ class MicroBatcher:
                 off += n
                 wait_ms = (now - req.t_enqueue) * 1e3
                 profiling.observe("serve.latency_ms", wait_ms)
+                if self._labels:
+                    profiling.observe(
+                        profiling.labeled("serve.latency_ms",
+                                          **self._labels), wait_ms)
                 telemetry.event(
                     "serve.dispatch", trace_id=req.trace_id,
                     parent_id=req.parent_id, rows=n, kind=kind,
@@ -248,3 +274,15 @@ class MicroBatcher:
                     batch_trace=leader.trace_id,
                     batch_requests=len(reqs),
                     wait_ms=round(wait_ms, 3))
+            # shadow canary (registry.maybe_shadow): double-score this
+            # group on a staged candidate AFTER every client's future
+            # resolved — stable-path latency never includes it.  One
+            # attribute read when no candidate is pending.
+            shadow = getattr(self._source, "maybe_shadow", None)
+            if shadow is not None:
+                try:
+                    shadow(X, kind, preds, requests=len(reqs))
+                except Exception as e:  # noqa: BLE001 — the canary
+                    # must never take the flusher down
+                    log.warning(f"shadow scoring failed: "
+                                f"{type(e).__name__}: {e}")

@@ -1,0 +1,357 @@
+"""Keyed model catalog: N independent tenants on one serving fleet.
+
+The reference C API serves any number of independent Booster handles
+per process (``LGBM_BoosterCreate`` — one handle per model); the PR 1-12
+serving stack assumed ONE hot-swapped model generation.  Production is
+neither: dozens of models (per country, per surface, A/B arms) share a
+fleet, each with its own SLO, publish cadence, and failure domain.  The
+catalog generalizes `ModelRegistry`/`PredictorRuntime` from one
+generation to N tenants:
+
+- **Keyed routing** — every tenant id maps to its own `ModelRegistry`
+  (atomic hot-swap, shadow canary, replica breakers) and its own
+  `MicroBatcher` (continuous batching, per-tenant admission budget).
+  `/predict` routes by the ``model`` body field / query param /
+  ``X-Model-Id`` header; requests that name no model go to the DEFAULT
+  tenant, which preserves the single-model contract bitwise.
+- **Isolation by construction** — per-tenant registries, executable
+  caches, batcher queues, and circuit breakers mean a torn publish or
+  a broken replica on tenant A cannot change a single bit of tenant
+  B's answers, nor put a compile on B's request path
+  (tests/test_catalog.py chaos suite).
+- **LRU executable budget** (``serve_cache_budget_mb``) — compiled
+  executables are the device-memory cost that scales with tenants x
+  buckets x kinds; the catalog sums each tenant's estimated executable
+  bytes and, beyond the budget, evicts the least-recently-used
+  tenants' caches (never the most recently used one).  An evicted
+  tenant keeps serving — its next request recompiles, counted as
+  churn through ``serve/cache_evictions`` (plus the per-model labeled
+  series).  0 = unlimited, and the single-tenant path never evicts.
+- **Per-model accounting** — requests/rows/rejections/latency
+  percentiles/queue depth per tenant ride the `profiling.labeled`
+  series (``lgbt_serve_requests_total{model="..."}`` at /metrics) and
+  the server's ``/stats`` ``models`` block.
+
+One `OnlineTrainer` per tenant (online/trainer.py `OnlineFleet`)
+shares the labeled-traffic tail — rows are keyed by the same model
+ids, each daemon publishes to its tenant's model path, and the
+catalog's per-tenant polls pick the publishes up — so trace ids still
+reconstruct any single tenant's serve→train→serve loop.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Dict, List, Optional
+
+from .. import log, profiling
+from ..config import MODEL_ID_RE
+from ..log import LightGBMError
+from .batcher import MicroBatcher
+from .registry import ModelRegistry
+
+DEFAULT_MODEL_ID = "default"
+
+
+class UnknownModelError(LightGBMError):
+    """Request named a model id the catalog does not serve (HTTP 404)."""
+
+
+class _Tenant:
+    """One tenant's serving column: registry + batcher + LRU tick."""
+    __slots__ = ("model_id", "registry", "batcher", "last_used")
+
+    def __init__(self, model_id: str, registry: ModelRegistry,
+                 batcher: MicroBatcher):
+        self.model_id = model_id
+        self.registry = registry
+        self.batcher = batcher
+        self.last_used = 0
+
+
+class ModelCatalog:
+    """Keyed (model id → registry/batcher) serving catalog.
+
+    ``models`` is an ordered ``{id: model path}`` mapping
+    (config.parse_serve_models output).  Every registry/batcher knob is
+    shared across tenants — per-tenant knobs beyond the model path are
+    deliberately out of scope until an operator needs them — except
+    that ``max_pending_rows`` applies PER TENANT (it is an admission
+    budget, so a hot tenant sheds its own load).
+    """
+
+    def __init__(self, models: Dict[str, str],
+                 params: Optional[dict] = None, *,
+                 default_id: Optional[str] = None,
+                 cache_budget_mb: int = 0,
+                 num_iteration: int = -1, max_batch_rows: int = 4096,
+                 min_bucket_rows: int = 16,
+                 flush_deadline_ms: float = 5.0,
+                 max_pending_rows: int = 0,
+                 predict_kernel: Optional[str] = None, replicas: int = 0,
+                 failure_threshold: int = 3,
+                 serve_quantize: str = "auto",
+                 shadow_fraction: float = 0.0,
+                 shadow_requests: int = 32,
+                 shadow_max_divergence: float = -1.0,
+                 warmup_buckets=(1,)):
+        if not models:
+            raise LightGBMError("ModelCatalog needs at least one "
+                                "model id=path entry")
+        for mid in models:
+            if not MODEL_ID_RE.match(str(mid)):
+                raise LightGBMError(
+                    f"model id {mid!r} must match [A-Za-z0-9._-]{{1,64}}")
+        default_id = (default_id if default_id is not None
+                      else next(iter(models)))
+        if default_id not in models:
+            raise LightGBMError(
+                f"default model id {default_id!r} is not in the "
+                f"catalog ({sorted(models)})")
+        self._init_base(default_id, cache_budget_mb)
+        for mid, path in models.items():
+            registry = ModelRegistry(
+                path, params=params, num_iteration=num_iteration,
+                max_batch_rows=max_batch_rows,
+                min_bucket_rows=min_bucket_rows,
+                predict_kernel=predict_kernel, replicas=replicas,
+                failure_threshold=failure_threshold,
+                serve_quantize=serve_quantize, model_id=mid,
+                shadow_fraction=shadow_fraction,
+                shadow_requests=shadow_requests,
+                shadow_max_divergence=shadow_max_divergence,
+                warmup_buckets=warmup_buckets)
+            batcher = MicroBatcher(
+                registry, max_batch_rows=max_batch_rows,
+                flush_deadline_ms=flush_deadline_ms,
+                workers=getattr(registry.current(), "replica_count", 1),
+                max_pending_rows=max_pending_rows, model_id=mid)
+            self._tenants[mid] = _Tenant(mid, registry, batcher)
+        log.info(f"model catalog serving {len(self._tenants)} tenants "
+                 f"({', '.join(self._tenants)}; default "
+                 f"{self.default_id!r}"
+                 + (f", cache budget {self.cache_budget_mb} MiB"
+                    if self.cache_budget_mb else "") + ")")
+        self.enforce_budget()                # construction already warms
+
+    def _init_base(self, default_id: str, cache_budget_mb: int) -> None:
+        """Every non-tenant attribute of a catalog, in ONE place —
+        `__init__` and the `from_registry` shim both build on this, so
+        an attribute added here can never be missing on the shim
+        path."""
+        self.default_id = default_id
+        self.cache_budget_mb = max(0, int(cache_budget_mb))
+        self._lock = threading.Lock()        # LRU ticks + eviction scan
+        self._tick = itertools.count(1)
+        self._miss_mark = -1                 # submit-path dirty check
+        self._tenants: Dict[str, _Tenant] = {}
+
+    @classmethod
+    def from_registry(cls, registry: ModelRegistry, *,
+                      model_id: str = DEFAULT_MODEL_ID,
+                      max_batch_rows: int = 4096,
+                      flush_deadline_ms: float = 5.0,
+                      max_pending_rows: int = 0,
+                      cache_budget_mb: int = 0) -> "ModelCatalog":
+        """Wrap an ALREADY-BUILT registry as a one-tenant catalog — the
+        back-compat shim behind ``PredictionServer(registry)``.  The
+        single-model server keeps its pre-catalog behavior: same
+        routing (everything lands on the one tenant), no eviction
+        unless a budget is set; the per-model labeled series simply
+        ride along under the default id."""
+        self = cls.__new__(cls)
+        self._init_base(model_id, cache_budget_mb)
+        if registry.model_id is None:
+            registry.model_id = model_id
+            rt = registry.current()
+            if getattr(rt, "model_id", None) is None:
+                rt.model_id = model_id
+        batcher = MicroBatcher(
+            registry, max_batch_rows=max_batch_rows,
+            flush_deadline_ms=flush_deadline_ms,
+            workers=getattr(registry.current(), "replica_count", 1),
+            max_pending_rows=max_pending_rows,
+            model_id=registry.model_id)
+        self._tenants[model_id] = _Tenant(model_id, registry, batcher)
+        return self
+
+    # -- lookup / routing ----------------------------------------------
+
+    def ids(self) -> List[str]:
+        return list(self._tenants)
+
+    def get(self, model_id: Optional[str] = None) -> _Tenant:
+        """The tenant for a request's model id (None = default)."""
+        mid = self.default_id if model_id is None else model_id
+        tenant = self._tenants.get(mid)
+        if tenant is None:
+            raise UnknownModelError(
+                f"unknown model {mid!r}; this catalog serves "
+                f"{sorted(self._tenants)}")
+        return tenant
+
+    def default(self) -> _Tenant:
+        return self._tenants[self.default_id]
+
+    def submit(self, X, kind: str = "value",
+               model_id: Optional[str] = None,
+               trace_id: Optional[str] = None,
+               parent_id: Optional[str] = None):
+        """Route one request: touch the tenant's LRU tick, enqueue on
+        its batcher, keep the executable budget honored.  Returns the
+        (tenant, future) pair — the caller reads the scoring generation
+        off the future like before."""
+        tenant = self.get(model_id)
+        with self._lock:
+            tenant.last_used = next(self._tick)
+        fut = tenant.batcher.submit(X, kind=kind, trace_id=trace_id,
+                                    parent_id=parent_id)
+        if self.cache_budget_mb:
+            # cheap dirty check on the hot path: cache totals only
+            # move when something COMPILED, so the O(tenants) byte
+            # scan (one lock per runtime) runs only after a cache
+            # miss somewhere, not on every request
+            marks = sum(t.registry.current().cache_misses
+                        for t in self._tenants.values())
+            if marks != self._miss_mark:
+                self._miss_mark = marks
+                self.enforce_budget()
+        return tenant, fut
+
+    # -- LRU executable budget -----------------------------------------
+
+    def cache_bytes(self) -> Dict[str, int]:
+        """Per-tenant estimated executable bytes (stable runtime plus
+        any staged shadow candidate — registry.cache_bytes)."""
+        return {mid: t.registry.cache_bytes()
+                for mid, t in self._tenants.items()}
+
+    def enforce_budget(self) -> int:
+        """Evict least-recently-used tenants' executable caches until
+        the total fits ``serve_cache_budget_mb``.  The most recently
+        used tenant is NEVER evicted (a budget smaller than one
+        tenant's working set degrades to single-tenant residency, not
+        thrash-to-zero).  Staged shadow candidates count toward — and
+        evict with — their tenant.  Returns executables evicted."""
+        if not self.cache_budget_mb:
+            return 0
+        budget = self.cache_budget_mb << 20
+        with self._lock:
+            order = sorted(self._tenants.values(),
+                           key=lambda t: t.last_used)   # LRU first
+        total = sum(t.registry.cache_bytes() for t in order)
+        evicted = 0
+        for tenant in order[:-1]:            # MRU tenant is protected
+            if total <= budget:
+                break
+            if tenant.registry.cache_bytes() <= 0:
+                continue
+            evicted += tenant.registry.evict_executables()
+            # recompute rather than subtract an estimate: eviction
+            # frees exactly what the caches now report as gone
+            total = sum(t.registry.cache_bytes() for t in order)
+        if total > budget and evicted:
+            log.info(f"serve cache budget: still {total >> 20} MiB "
+                     f"after eviction (budget {self.cache_budget_mb} "
+                     "MiB covers less than the hottest tenant)")
+        return evicted
+
+    # -- polling / swap -------------------------------------------------
+
+    def poll_once(self) -> int:
+        """Poll every tenant's model path; returns swaps landed.  Runs
+        budget enforcement afterwards — a freshly warmed generation is
+        exactly when totals can jump."""
+        swaps = 0
+        for tenant in self._tenants.values():
+            try:
+                if tenant.registry.poll_once():
+                    swaps += 1
+            except Exception as e:   # one tenant's poll failure must
+                # not starve the others' reloads
+                log.warning(f"model poll failed for "
+                            f"{tenant.model_id}: {e}")
+        if self.cache_budget_mb:
+            self.enforce_budget()
+        return swaps
+
+    def _mark_hup_all(self) -> None:
+        for tenant in self._tenants.values():
+            tenant.registry._hup_pending = True
+
+    def force_reload_all(self) -> None:
+        """SIGHUP semantics across the catalog: force-reload every
+        tenant on this call (bypassing any pending shadow canaries —
+        the registries' forced-reload escape hatch)."""
+        self._mark_hup_all()
+        self.poll_once()
+
+    def install_sighup(self) -> bool:
+        """SIGHUP → force-reload EVERY tenant (the shared serving
+        SIGHUP convention — registry.install_sighup_handler).  Main
+        thread only."""
+        from .registry import install_sighup_handler
+        return install_sighup_handler(self._mark_hup_all, self.poll_once)
+
+    # -- stats ----------------------------------------------------------
+
+    def tenant_stats(self) -> Dict[str, dict]:
+        """The /stats ``models`` block: per-tenant SLO + fleet view."""
+        out: Dict[str, dict] = {}
+        for mid, t in self._tenants.items():
+            reg, rt = t.registry, t.registry.current()
+            labels = {"model": mid}
+            out[mid] = {
+                "generation": reg.generation,
+                "model_path": reg.model_path,
+                "default": mid == self.default_id,
+                "requests": profiling.counter_value(
+                    profiling.labeled("serve.requests", **labels)),
+                "rows": profiling.counter_value(
+                    profiling.labeled("serve.rows", **labels)),
+                "rejected": profiling.counter_value(
+                    profiling.labeled("serve.rejected", **labels)),
+                "latency_ms": profiling.summary(
+                    profiling.labeled("serve.latency_ms", **labels)),
+                "queue_depth": t.batcher.queue_depth,
+                "pending_rows_cap": t.batcher.max_pending_rows,
+                "batch_workers": t.batcher.workers,
+                "swaps": reg.swaps,
+                "swap_failures": reg.swap_failures,
+                "last_swap_error": reg.last_swap_error,
+                "shadow": reg.shadow_state(),
+                "cache_bytes": reg.cache_bytes(),
+                "evictions": profiling.counter_value(
+                    profiling.labeled(profiling.SERVE_CACHE_EVICTIONS,
+                                      **labels)),
+                "replicas": {
+                    "count": getattr(rt, "replica_count", 1),
+                    "healthy": (rt.healthy_count()
+                                if hasattr(rt, "healthy_count") else 1),
+                },
+                "serve_quantize": getattr(rt, "variant", "raw"),
+            }
+        return out
+
+    def gauges(self) -> Dict[str, float]:
+        """Per-model live gauges for /metrics (labeled series)."""
+        g: Dict[str, float] = {}
+        for mid, t in self._tenants.items():
+            rt = t.registry.current()
+            g[profiling.labeled("serve.model_generation", model=mid)] = (
+                t.registry.generation)
+            g[profiling.labeled("serve.queue_depth", model=mid)] = (
+                t.batcher.queue_depth)
+            g[profiling.labeled("serve.healthy_replicas", model=mid)] = (
+                rt.healthy_count() if hasattr(rt, "healthy_count") else 1)
+            g[profiling.labeled("serve.cache_bytes", model=mid)] = (
+                t.registry.cache_bytes())
+        g["serve.models"] = len(self._tenants)
+        g["serve.cache_budget_mb"] = self.cache_budget_mb
+        return g
+
+    # -- lifecycle ------------------------------------------------------
+
+    def close(self) -> None:
+        for tenant in self._tenants.values():
+            tenant.batcher.close()

@@ -147,14 +147,19 @@ def resolve_serve_replicas(replicas: int = 0) -> list:
 class _Replica:
     """One device's copy of the model: device-resident stacks plus its
     own executable cache and dispatch/health bookkeeping."""
-    __slots__ = ("index", "device", "stacks", "compiled", "inflight",
-                 "dispatches", "failures", "broken", "skips", "probes")
+    __slots__ = ("index", "device", "stacks", "compiled", "exe_bytes",
+                 "inflight", "dispatches", "failures", "broken", "skips",
+                 "probes")
 
     def __init__(self, index: int, device, stacks):
         self.index = index
         self.device = device
         self.stacks = stacks
         self.compiled: Dict[Tuple[int, str], object] = {}
+        # estimated device bytes per compiled executable (same keys as
+        # `compiled`) — what the catalog's serve_cache_budget_mb LRU
+        # accounting sums
+        self.exe_bytes: Dict[Tuple[int, str], int] = {}
         self.inflight = 0
         self.dispatches = 0
         self.failures = 0       # CONSECUTIVE dispatch failures
@@ -180,7 +185,8 @@ class PredictorRuntime:
                  generation: int = 0, predict_kernel: Optional[str] = None,
                  replicas: int = 0, failure_threshold: int = 3,
                  probe_after: Optional[int] = None,
-                 quantize: str = "raw", refbin=None):
+                 quantize: str = "raw", refbin=None,
+                 model_id: Optional[str] = None):
         import jax
         from ..ops.predict import resolve_predict_kernel
 
@@ -193,6 +199,9 @@ class PredictorRuntime:
             raise ValueError("max_batch_rows must be >= 1")
         min_bucket_rows = max(1, min(min_bucket_rows, max_batch_rows))
         self.generation = generation
+        # catalog tenant id (None outside the multi-tenant catalog):
+        # stamps replica spans and the per-model telemetry labels
+        self.model_id = model_id
         self.max_batch_rows = int(max_batch_rows)
         self.min_bucket_rows = int(min_bucket_rows)
         self.objective = gbdt.objective
@@ -426,6 +435,23 @@ class PredictorRuntime:
         profiling.count("serve.compile_seconds", dt)
         return compiled
 
+    def _exe_bytes(self, compiled, bucket: int) -> int:
+        """Estimated device bytes one compiled executable keeps live —
+        what the catalog's serve_cache_budget_mb accounting charges.
+        XLA's own memory analysis where the backend reports it; the
+        analytic request-buffer + output + temp-free floor otherwise."""
+        try:
+            ma = compiled.memory_analysis()
+            total = int((getattr(ma, "argument_size_in_bytes", 0) or 0)
+                        + (getattr(ma, "output_size_in_bytes", 0) or 0)
+                        + (getattr(ma, "temp_size_in_bytes", 0) or 0))
+            if total > 0:
+                return total
+        except Exception:  # noqa: BLE001 — estimate, never a failure
+            pass
+        in_bytes = bucket * self._buf_cols * np.dtype(self._buf_dtype).itemsize
+        return int(in_bytes + self.K * bucket * 4)
+
     def _get_executable(self, replica: _Replica, bucket: int, kind: str):
         # the kernel VARIANT is part of the key: a binned and a raw
         # executable at the same (bucket, kind) are different programs
@@ -442,6 +468,7 @@ class PredictorRuntime:
         exe = self._build(replica, bucket, kind)
         with self._lock:
             winner = replica.compiled.setdefault(key, exe)
+            replica.exe_bytes.setdefault(key, self._exe_bytes(exe, bucket))
             self.cache_misses += 1
             profiling.count("serve.cache_miss")
         return winner
@@ -457,6 +484,38 @@ class PredictorRuntime:
             for r in self.replicas:
                 keys.update((b, k) for b, k, _v in r.compiled)
             return sorted(keys)
+
+    def cache_bytes(self) -> int:
+        """Estimated device bytes held by this runtime's compiled
+        executables across every replica — the quantity the catalog's
+        `serve_cache_budget_mb` LRU accounting sums per tenant."""
+        with self._lock:
+            return sum(sum(r.exe_bytes.values()) for r in self.replicas)
+
+    def evict_executables(self) -> int:
+        """Drop every compiled executable (every replica) — the
+        catalog's LRU budget enforcement.  The model stacks stay
+        device-resident, so the tenant keeps serving; its next request
+        simply recompiles its bucket (counted as churn through
+        serve/cache_evictions and the ordinary cache-miss counters).
+        In-flight dispatches keep their own executable references and
+        finish untouched."""
+        with self._lock:
+            n = sum(len(r.compiled) for r in self.replicas)
+            for r in self.replicas:
+                r.compiled.clear()
+                r.exe_bytes.clear()
+        if n:
+            profiling.count(profiling.SERVE_CACHE_EVICTIONS, n)
+            if self.model_id is not None:
+                profiling.count(profiling.labeled(
+                    profiling.SERVE_CACHE_EVICTIONS,
+                    model=self.model_id), n)
+            log.info(f"serving cache evicted {n} compiled executables"
+                     + (f" (model {self.model_id})" if self.model_id
+                        else "")
+                     + " to honor serve_cache_budget_mb")
+        return n
 
     def warmup(self, buckets: Sequence[int] = (),
                kinds: Sequence[str] = OUTPUT_KINDS) -> None:
@@ -581,10 +640,13 @@ class PredictorRuntime:
         try:
             # the replica-level hop of a request's trace: which chip ran
             # this chunk, at which bucket/kind, under which generation
+            # (and, in the multi-tenant catalog, for which model id)
             with telemetry.span("serve.replica", replica=replica.index,
                                 bucket=bucket, kind=kind,
                                 variant=self.variant,
-                                generation=self.generation):
+                                generation=self.generation,
+                                **({"model": self.model_id}
+                                   if self.model_id is not None else {})):
                 # chaos seams: a dispatch raising (any replica / THIS
                 # replica) is the circuit breaker's trigger condition
                 faults.check("serve.dispatch")

@@ -5,7 +5,13 @@ Endpoints:
   of feature values, or ``{"features": [...]}``.  A single JSON object
   ``{"rows": [[...], ...]}`` is also accepted.  Response is JSON lines,
   one prediction per input row (a number, or an array for multiclass).
-  ``?raw_score=1`` returns raw margins.  A trace id rides in via the
+  ``?raw_score=1`` returns raw margins.  On a multi-tenant catalog
+  (docs/serving.md "Multi-tenant catalog") the request routes by model
+  id — ``?model=<id>`` query param, ``"model"`` object-body field, or
+  ``X-Model-Id`` header; no id = the default tenant (the single-model
+  contract), an unknown id = 404.  The response names the tenant that
+  scored it (``X-Model-Id``) and its generation
+  (``X-Model-Generation``).  A trace id rides in via the
   ``X-Trace-Id`` header or a ``"trace_id"`` field in the object body
   (one is generated when telemetry is on and none arrives); the
   response echoes it as ``X-Trace-Id``, and the request's whole path —
@@ -35,20 +41,25 @@ from typing import Optional, Tuple
 import numpy as np
 
 from .. import log, profiling, telemetry
-from ..config import Config
+from ..config import MODEL_ID_RE, Config, parse_serve_models
 from ..log import LightGBMError
-from .batcher import MicroBatcher, ServerOverloadedError
+from .batcher import ServerOverloadedError
+from .catalog import ModelCatalog, UnknownModelError
 from .registry import ModelRegistry
 from .runtime import NoHealthyReplicaError
 
 
-def _parse_predict_body(body: bytes) -> Tuple[np.ndarray, Optional[str]]:
-    """Rows plus the optional ``trace_id`` field of the object form."""
+def _parse_predict_body(body: bytes) -> Tuple[np.ndarray, Optional[str],
+                                              Optional[str]]:
+    """Rows plus the optional ``trace_id`` and ``model`` fields of the
+    object form (the body-level model id routes multi-tenant catalogs;
+    JSON-lines bodies route via the query param / X-Model-Id header)."""
     text = body.decode("utf-8").strip()
     if not text:
         raise ValueError("empty request body")
     obj = None
     trace_id: Optional[str] = None
+    model_id: Optional[str] = None
     if text.startswith("{"):
         try:                                 # whole-body object form,
             obj = json.loads(text)           # pretty-printed or not
@@ -58,6 +69,9 @@ def _parse_predict_body(body: bytes) -> Tuple[np.ndarray, Optional[str]]:
         tid = obj.get("trace_id")
         if tid:
             trace_id = str(tid)
+        mid = obj.get("model")
+        if mid:
+            model_id = str(mid)
         if "rows" in obj:
             rows = obj["rows"]
         elif "features" in obj:
@@ -75,7 +89,7 @@ def _parse_predict_body(body: bytes) -> Tuple[np.ndarray, Optional[str]]:
     X = np.asarray(rows, dtype=np.float64)
     if X.ndim != 2:
         raise ValueError("rows must all have the same feature count")
-    return X, trace_id
+    return X, trace_id, model_id
 
 
 # client-supplied trace ids must be header-safe and bounded before they
@@ -105,8 +119,11 @@ class _Handler(BaseHTTPRequestHandler):
         srv: "PredictionServer" = self.server.prediction_server
         path = self.path.split("?", 1)[0]
         if path == "/healthz":
-            self._respond_json(200, {"status": "ok",
-                                     "generation": srv.registry.generation})
+            self._respond_json(200, {
+                "status": "ok",
+                "generation": srv.registry.generation,
+                "models": {mid: srv.catalog.get(mid).registry.generation
+                           for mid in srv.catalog.ids()}})
         elif path == "/stats":
             self._respond_json(200, srv.stats())
         elif path == "/metrics":
@@ -135,7 +152,7 @@ class _Handler(BaseHTTPRequestHandler):
         trace_id = None
         try:
             from urllib.parse import parse_qs
-            X, body_trace = _parse_predict_body(body)
+            X, body_trace, body_model = _parse_predict_body(body)
             # trace ingress: header first, then the body field; with
             # telemetry on and neither present, this server MINTS the id
             # so the request is traceable end-to-end regardless of the
@@ -153,18 +170,35 @@ class _Handler(BaseHTTPRequestHandler):
             raw = (qs["raw_score"][0] in ("1", "true")
                    if "raw_score" in qs else srv.default_raw)
             kind = "raw" if raw else "value"
+            # model routing (multi-tenant catalog): query param > body
+            # field > X-Model-Id header; absent = the default tenant.
+            # Validated like trace ids — the id is echoed into a
+            # response header and labels the per-model metric series.
+            raw_mid = (qs["model"][0] if "model" in qs else None) \
+                or body_model or self.headers.get("X-Model-Id")
+            if raw_mid is not None and not MODEL_ID_RE.match(raw_mid):
+                self._respond_json(400, {"error": (
+                    "malformed model id (must match "
+                    "[A-Za-z0-9._-]{1,64})")})
+                return
+            tenant = srv.catalog.get(raw_mid)
+            model_id = tenant.model_id
             with telemetry.span("serve.request", trace_id=trace_id,
-                                rows=int(X.shape[0]), kind=kind) as sp:
-                fut = srv.batcher.submit(
-                    X, kind=kind, trace_id=trace_id,
+                                rows=int(X.shape[0]), kind=kind,
+                                model=model_id) as sp:
+                _tenant, fut = srv.catalog.submit(
+                    X, kind=kind, model_id=model_id, trace_id=trace_id,
                     parent_id=sp.span_id)
                 preds = fut.result(timeout=srv.request_timeout_s)
                 # the generation that actually scored this batch
                 # (pinned by the flusher), not whatever is live at
                 # response time
                 generation = getattr(fut, "generation",
-                                     srv.registry.generation)
+                                     tenant.registry.generation)
                 sp.set(generation=generation)
+        except UnknownModelError as e:
+            self._respond_json(404, {"error": str(e)})
+            return
         except (ValueError, KeyError, json.JSONDecodeError) as e:
             self._respond_json(400, {"error": str(e)})
             return
@@ -193,6 +227,7 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(200)
         self.send_header("Content-Type", "application/jsonlines")
         self.send_header("X-Model-Generation", str(generation))
+        self.send_header("X-Model-Id", model_id)
         if trace_id:
             self.send_header("X-Trace-Id", trace_id)
         out = lines.encode()
@@ -202,35 +237,53 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class PredictionServer:
-    """HTTP server + batcher + model-poll thread, with clean teardown
-    (context manager) so tests never leak a listener."""
+    """HTTP server + model catalog + model-poll thread, with clean
+    teardown (context manager) so tests never leak a listener.
 
-    def __init__(self, registry: ModelRegistry, *, host: str = "127.0.0.1",
+    Accepts either a single `ModelRegistry` (wrapped as a one-tenant
+    catalog — the pre-catalog contract, bit-for-bit) or an explicit
+    `ModelCatalog` for multi-tenant serving.  Each tenant owns its
+    batcher (one flusher per predictor replica — continuous batching),
+    admission budget, and swap/canary machinery."""
+
+    def __init__(self, registry: Optional[ModelRegistry] = None, *,
+                 catalog: Optional[ModelCatalog] = None,
+                 host: str = "127.0.0.1",
                  port: int = 0, max_batch_rows: int = 4096,
                  flush_deadline_ms: float = 5.0,
                  model_poll_seconds: float = 10.0,
                  default_raw: bool = False, max_pending_rows: int = 0,
                  request_timeout_ms: float = 120000.0):
-        self.registry = registry
+        if (registry is None) == (catalog is None):
+            raise ValueError("PredictionServer needs exactly one of "
+                             "registry= or catalog=")
+        if catalog is None:
+            catalog = ModelCatalog.from_registry(
+                registry, max_batch_rows=max_batch_rows,
+                flush_deadline_ms=flush_deadline_ms,
+                max_pending_rows=max_pending_rows)
+        self.catalog = catalog
         self.default_raw = default_raw
         self.model_poll_seconds = float(model_poll_seconds)
         # /predict waiters give up (HTTP 504) after this long; the
         # Config key is serve_request_timeout_ms
         self.request_timeout_s = max(float(request_timeout_ms), 1.0) / 1e3
-        # one flusher per predictor replica: while one batch scores on a
-        # replica, the next forms and dispatches to an idle one —
-        # continuous batching across the fleet
-        workers = getattr(registry.current(), "replica_count", 1)
-        self.batcher = MicroBatcher(registry, max_batch_rows=max_batch_rows,
-                                    flush_deadline_ms=flush_deadline_ms,
-                                    workers=workers,
-                                    max_pending_rows=max_pending_rows)
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.prediction_server = self
         self.host, self.port = self._httpd.server_address[:2]
         self._stop = threading.Event()
         self._threads = []
+
+    # the single-model attribute surface (tests, benches, operators'
+    # scripts) stays: `registry`/`batcher` are the DEFAULT tenant's
+    @property
+    def registry(self) -> ModelRegistry:
+        return self.catalog.default().registry
+
+    @property
+    def batcher(self):
+        return self.catalog.default().batcher
 
     @staticmethod
     def _read_json_sidecar(path: str, what: str):
@@ -268,9 +321,11 @@ class PredictionServer:
     def _serve_gauges(self) -> dict:
         """Live fleet gauges for the /metrics exposition — the state a
         counter cannot carry (current queue depth, healthy replicas,
-        the generation in service)."""
+        the generation in service).  The unlabeled gauges describe the
+        DEFAULT tenant (the single-model contract); the catalog's
+        per-model labeled series ride alongside."""
         runtime = self.registry.current()
-        return {
+        g = {
             "serve.queue_depth": self.batcher.queue_depth,
             "serve.pending_rows_cap": self.batcher.max_pending_rows,
             "serve.batch_workers": self.batcher.workers,
@@ -281,14 +336,23 @@ class PredictionServer:
             "serve.model_generation": self.registry.generation,
             "serve.swaps": self.registry.swaps,
         }
+        g.update(self.catalog.gauges())
+        return g
 
     def metrics_text(self) -> str:
         return telemetry.prometheus_text(self._serve_gauges())
 
     def stats(self) -> dict:
+        """The operator view.  Top-level fields keep describing the
+        DEFAULT tenant plus the fleet-wide counters (the pre-catalog
+        contract); the ``models`` block carries per-tenant SLO
+        accounting (requests/rows/p99/queue/rejections), swap + canary
+        state, and executable-cache residency."""
         runtime = self.registry.current()
         return {
             "generation": self.registry.generation,
+            "default_model": self.catalog.default_id,
+            "models": self.catalog.tenant_stats(),
             # uptime / RSS / backend / version / telemetry config — the
             # operator's "which process is this" block
             "process": telemetry.process_info(),
@@ -368,7 +432,7 @@ class PredictionServer:
     def _poll_loop(self) -> None:
         while not self._stop.wait(self.model_poll_seconds):
             try:
-                self.registry.poll_once()
+                self.catalog.poll_once()     # every tenant's path
             except Exception as e:           # never kill the poll loop
                 log.warning(f"model poll failed: {e}")
 
@@ -376,7 +440,7 @@ class PredictionServer:
         self._stop.set()
         self._httpd.shutdown()
         self._httpd.server_close()
-        self.batcher.close()
+        self.catalog.close()
         for t in self._threads:
             t.join(timeout=10)
 
@@ -387,25 +451,59 @@ class PredictionServer:
         self.stop()
 
 
+def catalog_models_from_config(cfg: Config) -> "dict":
+    """The ``{model id: path}`` map a config describes: `serve_models`
+    entries, plus `input_model` as the ``default`` tenant when set
+    (requests that name no model land there — the single-model
+    contract).  With only `serve_models`, the FIRST entry is the
+    default."""
+    models = parse_serve_models(cfg.serve_models)
+    if cfg.input_model:
+        dup = models.get("default")
+        if dup is not None and dup != cfg.input_model:
+            # refusing beats silently serving the wrong file: both
+            # sources claim the default tenant with different models
+            raise LightGBMError(
+                "input_model and a serve_models entry both name the "
+                f"'default' tenant with different paths "
+                f"({cfg.input_model!r} vs {dup!r}); rename the entry "
+                "or drop input_model")
+        merged = {"default": cfg.input_model}
+        for mid, path in models.items():
+            if mid != "default":
+                merged[mid] = path
+        return merged
+    if not models:
+        raise LightGBMError("task=serve needs a model: set "
+                            "input_model=<file> and/or "
+                            "serve_models=id=path,...")
+    return models
+
+
 def server_from_config(cfg: Config) -> PredictionServer:
-    """Build (not start) a PredictionServer from CLI/config parameters."""
-    if not cfg.input_model:
-        raise LightGBMError("task=serve needs a model: set input_model=<file>")
-    registry = ModelRegistry(
-        cfg.input_model, params={"verbose": cfg.verbose},
+    """Build (not start) a PredictionServer from CLI/config parameters:
+    one catalog tenant per `serve_models` entry (plus `input_model` as
+    the default tenant), shared serving knobs across tenants."""
+    models = catalog_models_from_config(cfg)
+    catalog = ModelCatalog(
+        models, params={"verbose": cfg.verbose},
+        default_id=next(iter(models)),
+        cache_budget_mb=cfg.serve_cache_budget_mb,
         num_iteration=cfg.num_iteration_predict,
         max_batch_rows=cfg.max_batch_rows,
         min_bucket_rows=cfg.min_bucket_rows,
+        flush_deadline_ms=cfg.flush_deadline_ms,
+        max_pending_rows=cfg.max_pending_rows,
         predict_kernel=cfg.predict_kernel,
         replicas=cfg.serve_replicas,
         failure_threshold=cfg.replica_failure_threshold,
-        serve_quantize=cfg.serve_quantize)
+        serve_quantize=cfg.serve_quantize,
+        shadow_fraction=cfg.serve_shadow_fraction,
+        shadow_requests=cfg.serve_shadow_requests,
+        shadow_max_divergence=cfg.serve_shadow_max_divergence)
     return PredictionServer(
-        registry, host=cfg.serve_host, port=cfg.serve_port,
-        max_batch_rows=cfg.max_batch_rows,
-        flush_deadline_ms=cfg.flush_deadline_ms,
+        catalog=catalog, host=cfg.serve_host, port=cfg.serve_port,
         model_poll_seconds=cfg.model_poll_seconds,
-        max_pending_rows=cfg.max_pending_rows,
         request_timeout_ms=cfg.serve_request_timeout_ms,
         default_raw=cfg.is_predict_raw_score)
 
@@ -415,7 +513,7 @@ def serve_from_config(cfg: Config) -> None:
     import signal
 
     server = server_from_config(cfg)
-    server.registry.install_sighup()
+    server.catalog.install_sighup()
     done = threading.Event()
 
     def _on_term(_signum, _frame):

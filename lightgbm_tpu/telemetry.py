@@ -342,6 +342,8 @@ def call_in_context(ctx: Optional[Tuple[str, Optional[str]]],
 _METRIC_PREFIX = "lgbt_"
 _BAD_CHARS = re.compile(r"[^a-zA-Z0-9_]")
 _QUANTILES = (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99"))
+# a labeled registry key (profiling.labeled): base{label="value",...}
+_LABELED_KEY = re.compile(r"^(?P<base>[^{]+)\{(?P<labels>.*)\}$")
 
 
 def sanitize_metric_name(name: str) -> str:
@@ -350,6 +352,40 @@ def sanitize_metric_name(name: str) -> str:
     s = _BAD_CHARS.sub("_", name).strip("_")
     s = re.sub(r"__+", "_", s)
     return _METRIC_PREFIX + s
+
+
+def _split_labels(name: str) -> Tuple[str, str]:
+    """Split a registry key into (base name, rendered label body).
+
+    ``serve.requests{model="de"}`` → ``("serve.requests",
+    'model="de"')``; label NAMES are sanitized to the Prometheus
+    charset and VALUES get quote/backslash escaping, so one malformed
+    key can never corrupt the whole exposition."""
+    m = _LABELED_KEY.match(name)
+    if m is None:
+        return name, ""
+    parts = []
+    for pair in m.group("labels").split(","):
+        k, _, v = pair.partition("=")
+        v = v.strip().strip('"')
+        v = v.replace("\\", r"\\").replace('"', r'\"').replace("\n", r"\n")
+        k = _BAD_CHARS.sub("_", k.strip()) or "label"
+        parts.append(f'{k}="{v}"')
+    return m.group("base"), ",".join(parts)
+
+
+def _families(values: Dict[str, float]) -> "Dict[str, list]":
+    """Group registry entries into metric families: {base name:
+    [(label body, value), ...]} with unlabeled series first, so HELP
+    and TYPE are emitted once per FAMILY even when a name exports both
+    a fleet-wide series and per-model labeled series."""
+    fams: Dict[str, list] = {}
+    for name in values:
+        base, labels = _split_labels(name)
+        fams.setdefault(base, []).append((labels, values[name]))
+    for series in fams.values():
+        series.sort(key=lambda s: (s[0] != "", s[0]))
+    return fams
 
 
 def _fmt(v) -> str:
@@ -421,30 +457,37 @@ def prometheus_text(gauges: Optional[Dict[str, float]] = None) -> str:
     for name in profiling.CANONICAL_COUNTERS:
         counters.setdefault(name, 0.0)
     lines = []
-    for name in sorted(counters):
-        m = sanitize_metric_name(name) + "_total"
-        lines.append(f"# HELP {m} counter {name!r} (lightgbm_tpu profiling)")
+    cfams = _families(counters)
+    for base in sorted(cfams):
+        m = sanitize_metric_name(base) + "_total"
+        lines.append(f"# HELP {m} counter {base!r} (lightgbm_tpu profiling)")
         lines.append(f"# TYPE {m} counter")
-        lines.append(f"{m} {_fmt(max(counters[name], 0.0))}")
-    for name in sorted(summaries):
-        s = summaries[name]
-        m = sanitize_metric_name(name)
-        lines.append(f"# HELP {m} summary of {name!r} samples")
+        for labels, v in cfams[base]:
+            suffix = f"{{{labels}}}" if labels else ""
+            lines.append(f"{m}{suffix} {_fmt(max(v, 0.0))}")
+    sfams = _families(summaries)
+    for base in sorted(sfams):
+        m = sanitize_metric_name(base)
+        lines.append(f"# HELP {m} summary of {base!r} samples")
         lines.append(f"# TYPE {m} summary")
-        for q, key in _QUANTILES:
-            if key in s:
-                lines.append(f'{m}{{quantile="{q}"}} {_fmt(s[key])}')
-        lines.append(f"{m}_count {_fmt(s.get('count', 0))}")
+        for labels, s in sfams[base]:
+            for q, key in _QUANTILES:
+                if key in s:
+                    qlab = (f'{labels},quantile="{q}"' if labels
+                            else f'quantile="{q}"')
+                    lines.append(f"{m}{{{qlab}}} {_fmt(s[key])}")
+            suffix = f"{{{labels}}}" if labels else ""
+            lines.append(f"{m}_count{suffix} {_fmt(s.get('count', 0))}")
     merged = process_gauges()
     merged.update(gauges or {})
-    for name in sorted(merged):
-        v = merged[name]
-        if v is None:
-            continue
-        m = sanitize_metric_name(name)
-        lines.append(f"# HELP {m} gauge {name!r}")
+    gfams = _families({k: v for k, v in merged.items() if v is not None})
+    for base in sorted(gfams):
+        m = sanitize_metric_name(base)
+        lines.append(f"# HELP {m} gauge {base!r}")
         lines.append(f"# TYPE {m} gauge")
-        lines.append(f"{m} {_fmt(v)}")
+        for labels, v in gfams[base]:
+            suffix = f"{{{labels}}}" if labels else ""
+            lines.append(f"{m}{suffix} {_fmt(v)}")
     return "\n".join(lines) + "\n"
 
 

@@ -27,13 +27,23 @@ to ``SERVE_BENCH_OUT``.  Four sections:
    transfers per request after warmup, asserted AFTER the JSON line
    prints so the chip-queue log always has the counter evidence.
 
+5. **Multi-tenant mode** (``SERVE_BENCH_TENANTS=M``, the
+   `bench_serve_mt` chip-queue stage) — replaces sections 1-3: M
+   catalog tenants on one fleet under MIXED per-tenant QPS (tenant 0
+   heaviest, weights M..1), per-model p50/p95/p99 + achieved QPS from
+   both the clients and the server's /stats `models` block, eviction
+   churn under ``SERVE_BENCH_CACHE_MB`` (0 = no budget), and the
+   BENCH_SANITIZE steady-state probe per tenant.
+
 Env knobs: SERVE_BENCH_TREES (500), SERVE_BENCH_LEAVES (63),
 SERVE_BENCH_DEPTH (8), SERVE_BENCH_ROWS (rows/request, 64),
 SERVE_BENCH_CLIENTS (8), SERVE_BENCH_SECONDS (10, per sustained side),
 SERVE_BENCH_QPS (0 = closed loop), SERVE_BENCH_REPLICAS (0 = auto),
 SERVE_BENCH_AB_ROWS (2048), SERVE_BENCH_AB_REPS (15), SERVE_BENCH_OUT,
 SERVE_BENCH_REQUIRE_SPEEDUP (kernel A/B gate),
-SERVE_BENCH_REQUIRE_BINNED (fail if binned rows/s < raw * this).
+SERVE_BENCH_REQUIRE_BINNED (fail if binned rows/s < raw * this),
+SERVE_BENCH_TENANTS (0 = single-model sections 1-4),
+SERVE_BENCH_CACHE_MB (multi-tenant executable budget, 0 = unlimited).
 """
 import json
 import os
@@ -58,6 +68,8 @@ QPS = float(os.environ.get("SERVE_BENCH_QPS", 0))
 REPLICAS = int(os.environ.get("SERVE_BENCH_REPLICAS", 0))
 AB_ROWS = int(os.environ.get("SERVE_BENCH_AB_ROWS", 2048))
 AB_REPS = int(os.environ.get("SERVE_BENCH_AB_REPS", 15))
+TENANTS = int(os.environ.get("SERVE_BENCH_TENANTS", 0))
+CACHE_MB = int(os.environ.get("SERVE_BENCH_CACHE_MB", 0))
 FEATURES = 28
 
 
@@ -185,21 +197,26 @@ def _quantize_ab(bst, X, refbin):
     return out
 
 
-def _sustained_load(server, X):
-    """CLIENTS concurrent HTTP clients for SECONDS; returns latency
-    percentiles + achieved rates."""
+def _sustained_load(server, X, model=None, clients=None, seconds=None):
+    """Concurrent HTTP clients for a fixed window; returns latency
+    percentiles + achieved rates.  ``model`` routes every request to
+    one catalog tenant (the multi-tenant mode runs one of these client
+    pools per tenant, concurrently)."""
     import http.client
+    clients = CLIENTS if clients is None else clients
+    seconds = SECONDS if seconds is None else seconds
+    path = "/predict" + (f"?model={model}" if model else "")
     latencies = []
     lat_lock = threading.Lock()
     errors = []
-    t_end = time.monotonic() + SECONDS
-    interval = CLIENTS / QPS if QPS > 0 else 0.0
+    t_end = time.monotonic() + seconds
+    interval = clients / QPS if QPS > 0 else 0.0
 
     def client(idx):
         conn = http.client.HTTPConnection(server.host, server.port,
                                           timeout=120)
         k = 0
-        start = time.monotonic() + (idx * interval / max(CLIENTS, 1))
+        start = time.monotonic() + (idx * interval / max(clients, 1))
         try:
             while time.monotonic() < t_end:
                 if interval:
@@ -213,7 +230,7 @@ def _sustained_load(server, X):
                 body = "\n".join(
                     json.dumps([float(v) for v in r]) for r in rows)
                 t0 = time.perf_counter()
-                conn.request("POST", "/predict", body)
+                conn.request("POST", path, body)
                 resp = conn.getresponse()
                 resp.read()
                 dt = time.perf_counter() - t0
@@ -228,7 +245,7 @@ def _sustained_load(server, X):
             conn.close()
 
     threads = [threading.Thread(target=client, args=(i,))
-               for i in range(CLIENTS)]
+               for i in range(clients)]
     t0 = time.monotonic()
     for t in threads:
         t.start()
@@ -249,7 +266,7 @@ def _sustained_load(server, X):
 
     return {
         "seconds": round(wall, 2),
-        "clients": CLIENTS,
+        "clients": clients,
         "rows_per_request": ROWS_PER_REQ,
         "target_qps": QPS or "closed-loop",
         "requests": len(lat),
@@ -260,11 +277,136 @@ def _sustained_load(server, X):
     }
 
 
+def _multi_tenant_main() -> None:
+    """SERVE_BENCH_TENANTS=M: M catalog tenants (copies of the
+    north-star model under distinct ids), mixed per-tenant QPS (tenant
+    0 heaviest), per-model p99 from clients AND the /stats models
+    block, eviction churn under SERVE_BENCH_CACHE_MB, per-tenant
+    sanitize probe."""
+    from lightgbm_tpu import profiling
+    from lightgbm_tpu.diagnostics.sanitize import (HotPathSanitizer,
+                                                   sanitize_enabled)
+    from lightgbm_tpu.serving import ModelCatalog, PredictionServer
+
+    t_train0 = time.monotonic()
+    bst, X, _refbin = _train_model()
+    train_s = time.monotonic() - t_train0
+    tenant_ids = [f"t{i}" for i in range(TENANTS)]
+    # mixed QPS: tenant 0 carries the most clients (weight M..1) — the
+    # "one hot tenant" shape the per-tenant accounting must resolve
+    weights = [TENANTS - i for i in range(TENANTS)]
+    wsum = sum(weights)
+    clients = {tid: max(1, round(CLIENTS * w / wsum))
+               for tid, w in zip(tenant_ids, weights)}
+    warm = []
+    b = ROWS_PER_REQ
+    while b <= min(max(clients.values()) * ROWS_PER_REQ, 4096):
+        warm.append(b)
+        b <<= 1
+    san_rec = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        models = {}
+        for tid in tenant_ids:
+            path = os.path.join(tmp, f"{tid}.txt")
+            bst.save_model(path)
+            models[tid] = path
+        catalog = ModelCatalog(
+            models, params={"verbose": -1}, max_batch_rows=4096,
+            flush_deadline_ms=2.0, replicas=REPLICAS,
+            cache_budget_mb=CACHE_MB,
+            warmup_buckets=tuple(warm) or (ROWS_PER_REQ,))
+        server = PredictionServer(catalog=catalog, model_poll_seconds=0)
+        evict0 = profiling.counter_value(profiling.SERVE_CACHE_EVICTIONS)
+        with server:
+            pools = {}
+            results = {}
+
+            def run_pool(tid):
+                results[tid] = _sustained_load(server, X, model=tid,
+                                               clients=clients[tid])
+
+            for tid in tenant_ids:
+                pools[tid] = threading.Thread(target=run_pool,
+                                              args=(tid,))
+            t0 = time.monotonic()
+            for t in pools.values():
+                t.start()
+            for t in pools.values():
+                t.join()
+            wall = time.monotonic() - t0
+            stats = server.stats()
+        evictions = (profiling.counter_value(
+            profiling.SERVE_CACHE_EVICTIONS) - evict0)
+        sans = []
+        if sanitize_enabled():
+            # steady-state probe per tenant, directly on its runtime
+            # (the transfer guard is thread-local); one unguarded call
+            # re-warms whatever the budget may have evicted.  Violations
+            # fail AFTER the JSON prints, as everywhere in this script.
+            Xq = np.ascontiguousarray(X[:ROWS_PER_REQ], np.float64)
+            for tid in tenant_ids:
+                rt = catalog.get(tid).registry.current()
+                rt.predict(Xq)
+                san = HotPathSanitizer(warmup=1, label=f"serve-mt-{tid}")
+                with san:
+                    for _ in range(6):
+                        with san.step():
+                            rt.predict(Xq)
+                san_rec[tid] = san.report()
+                sans.append(san)
+        catalog.close()
+    per_model = {}
+    for tid in tenant_ids:
+        load = results.get(tid, {})
+        srv_side = stats["models"].get(tid, {})
+        per_model[tid] = {
+            "clients": clients[tid],
+            "load": load,
+            "server_requests": srv_side.get("requests"),
+            "server_p99_ms": (srv_side.get("latency_ms") or {}).get("p99"),
+            "evictions": srv_side.get("evictions"),
+        }
+    worst_p99 = max((r["load"].get("p99_ms") or 0.0)
+                    for r in per_model.values())
+    out = {
+        "metric": f"multi-tenant serve fleet ({TENANTS} tenants, mixed "
+                  f"QPS): worst per-model p99 under sustained load",
+        "value": worst_p99,
+        "unit": "ms",
+        "train_s": round(train_s, 1),
+        "model": {"trees": TREES, "num_leaves": LEAVES,
+                  "max_depth": DEPTH},
+        "tenants": per_model,
+        "wall_s": round(wall, 2),
+        "cache_budget_mb": CACHE_MB,
+        "evictions": evictions,
+        "default_model": stats["default_model"],
+    }
+    if san_rec:
+        out["sanitize"] = san_rec
+    line = json.dumps(out)
+    print(line)
+    dest = os.environ.get("SERVE_BENCH_OUT", "")
+    if dest:
+        with open(dest, "w") as f:
+            f.write(line + "\n")
+    for tid, rec in results.items():
+        if "error" in rec:
+            raise SystemExit(f"sustained load ({tid}) failed: "
+                             f"{rec['error']}")
+    for san in sans:
+        san.check()     # fail AFTER the JSON so counters are recorded
+
+
 def main() -> None:
     from lightgbm_tpu import profiling
     from lightgbm_tpu.diagnostics.sanitize import (HotPathSanitizer,
                                                    sanitize_enabled)
     from lightgbm_tpu.serving import ModelRegistry, PredictionServer
+
+    if TENANTS > 0:
+        _multi_tenant_main()
+        return
 
     t_train0 = time.monotonic()
     bst, X, refbin = _train_model()

@@ -1,7 +1,10 @@
 """Counter-name lint: keep the profiling registry's names mechanical.
 
 Two rules over every ``profiling.count`` / ``count_deferred`` /
-``observe`` call site in the package (plus bench.py and scripts/):
+``observe`` / ``labeled`` call site in the package (plus bench.py and
+scripts/) — ``labeled`` builds the per-model series keys
+(``lgbt_..._total{model="..."}``), whose base names are ordinary
+registry names:
 
 1. **use-the-constant** — a call site whose first argument is a string
    LITERAL equal to the value of a module-level canonical constant
@@ -29,8 +32,13 @@ from typing import Dict, List, Tuple
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # the profiling-registry entry points whose first argument is a counter
-# or reservoir name
-CALLS = ("count", "count_deferred", "observe")
+# or reservoir name.  `labeled` is the per-model series constructor
+# (profiling.labeled("serve.requests", model=...) → the registry key
+# rendered as lgbt_serve_requests_total{model="..."}): its BASE name
+# follows the same rules as any other registry name — canonical
+# constants must be used, and a base that differs from another name
+# only by separator style would merge with it at /metrics.
+CALLS = ("count", "count_deferred", "observe", "labeled")
 
 # where canonical constants live (module-level UPPER_CASE = "string")
 CONSTANT_MODULES = (

@@ -170,6 +170,42 @@ def main():
         "f32 feature traversal.  `auto` picks binned whenever a valid "
         "sidecar is present and falls back to raw otherwise.  See "
         "docs/serving.md \"Binned inference\".",
+        "- `serve_models` (default empty, aliases `serving_models`, "
+        "`model_catalog`): multi-tenant serving catalog — `id=path` "
+        "entries, one independent model per tenant id.  `/predict` "
+        "routes by `?model=`, the `\"model\"` body field, or the "
+        "`X-Model-Id` header; requests naming no model land on the "
+        "default tenant (`input_model` when set, else the first "
+        "entry).  Each tenant gets its own registry (hot-swap, shadow "
+        "canary, replica breakers), batcher (per-tenant "
+        "`max_pending_rows` admission budget), executable caches, and "
+        "per-model `/stats` + labeled `/metrics` accounting.  Also "
+        "consumed by `task=online`: one refresh daemon per entry "
+        "sharing the traffic tail (keyed rows, keyed publish paths).  "
+        "See docs/serving.md \"Multi-tenant catalog\".",
+        "- `serve_cache_budget_mb` (default `0`, aliases "
+        "`serve_cache_budget`, `cache_budget_mb`): device-memory "
+        "budget (MiB) for the catalog's compiled-executable caches "
+        "across ALL tenants.  Beyond it, the least-recently-used "
+        "tenants' executables are evicted (never the most recently "
+        "used tenant's; model stacks stay resident, so evicted "
+        "tenants keep serving and recompile on their next request — "
+        "`serve/cache_evictions` counts the churn).  `0` = unlimited.",
+        "- `serve_shadow_fraction` (default `0.0`, aliases "
+        "`shadow_fraction`, `canary_fraction`): shadow-canary "
+        "publishes — with a fraction > 0, a republished model is "
+        "STAGED and this fraction of requests is double-scored on it "
+        "(stable still answers every client; shadow scoring runs "
+        "after the clients' futures resolve), logging per-request "
+        "divergence until the verdict.  `0` = immediate hot swap.",
+        "- `serve_shadow_requests` (default `32`, aliases "
+        "`shadow_requests`, `canary_requests`): shadowed comparisons "
+        "required before the canary verdict (adopt or reject).",
+        "- `serve_shadow_max_divergence` (default `-1.0`, aliases "
+        "`shadow_max_divergence`, `canary_max_divergence`): reject "
+        "the candidate when any shadowed |candidate - stable| "
+        "divergence exceeds this (`>= 0`); negative = log-only, "
+        "always adopt after the quorum.",
         "",
         "## Online learning",
         "",

@@ -57,6 +57,11 @@ stage profile env BENCH_SANITIZE=1 python scripts/profile_hotpath.py || exit 1
 # and on binned throughput >= raw (the fixed-point traversal's
 # memory-bandwidth win must be real on chip)
 stage bench_serve env BENCH_SANITIZE=1 SERVE_BENCH_SECONDS=10 SERVE_BENCH_REQUIRE_BINNED=1.0 SERVE_BENCH_OUT=.bench/bench_serve.json python scripts/bench_serve.py || exit 1
+# multi-tenant catalog: 3 tenants at mixed QPS on one fleet —
+# per-model p99 + /stats accounting, LRU eviction churn under a
+# deliberately tight executable budget, and the per-tenant
+# steady-state sanitize probe (0 retraces / 0 implicit transfers)
+stage bench_serve_mt env BENCH_SANITIZE=1 SERVE_BENCH_TENANTS=3 SERVE_BENCH_SECONDS=8 SERVE_BENCH_CACHE_MB=64 SERVE_BENCH_OUT=.bench/bench_serve_mt.json python scripts/bench_serve.py || exit 1
 # online-learning refresh loop at the reduced north-star shape:
 # refit-vs-retrain wall-clock (>= 10x gate) + AUC-after-drift recovery,
 # steady-state refits under the sanitizer (0 retraces / 0 implicit

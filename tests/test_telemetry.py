@@ -162,9 +162,12 @@ def test_summary_nearest_rank_percentiles():
 # Prometheus text exposition
 # ---------------------------------------------------------------------------
 
-# one metric line: name, optional {quantile="0.x"} label, numeric value
+# one metric line: name, optional label set (per-model series like
+# {model="de"}, summary {quantile="0.x"}, or both), numeric value
 _METRIC_LINE = re.compile(
-    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{quantile="0\.\d+"\})? '
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})? '
     r'-?\d+(\.\d+)?([eE][+-]?\d+)?$')
 
 
