@@ -63,10 +63,12 @@ class Application:
         # distinguishable (and land in separate chrome-trace pid lanes)
         telemetry.set_process(cfg.task)
         # standalone Prometheus /metrics for roles without their own
-        # HTTP server; task=serve mounts the same payload on its own
-        # endpoint instead (serving/server.py)
+        # HTTP server; task=serve and task=route mount the same payload
+        # on their own endpoints instead (serving/server.py,
+        # router/server.py)
         metrics_srv = None
-        if cfg.metrics_port and cfg.task not in ("serve", "serving"):
+        if cfg.metrics_port and cfg.task not in ("serve", "serving",
+                                                 "route", "router"):
             metrics_srv = telemetry.start_metrics_server(
                 cfg.metrics_port, host=cfg.serve_host)
         try:
@@ -76,6 +78,8 @@ class Application:
                 self._predict()
             elif cfg.task in ("serve", "serving"):
                 self._serve()
+            elif cfg.task in ("route", "router"):
+                self._route()
             elif cfg.task in ("online", "online_train"):
                 self._online()
             elif cfg.task in ("refit", "refit_tree"):
@@ -214,6 +218,15 @@ class Application:
     def _serve(self) -> None:
         from .serving.server import serve_from_config
         serve_from_config(self.config)
+
+    # ------------------------------------------------------------------
+    def _route(self) -> None:
+        """task=route: the stdlib-only router tier fronting M backend
+        task=serve processes (lightgbm_tpu/router/, docs/Router.md) —
+        consistent-hash tenant placement, per-backend circuit breakers,
+        fleet-aggregated /stats + /metrics."""
+        from .router import route_from_config
+        route_from_config(self.config)
 
     # ------------------------------------------------------------------
     def _online(self) -> None:

@@ -64,6 +64,52 @@ def parse_serve_models(entries) -> Dict[str, str]:
     return out
 
 
+def parse_route_backends(entries) -> Tuple[Tuple[str, ...], Dict[str, str]]:
+    """``("127.0.0.1:8081", "de=127.0.0.1:8082")`` →
+    ``(backends, overrides)``.  The ONE place the `route_backends`
+    grammar lives — config validation and the `task=route` router both
+    route through here.  A bare ``host:port`` entry is a backend; an
+    entry with ``=`` is an explicit placement override pinning a model
+    id to one of the listed backends (it must appear as a bare entry
+    too — an override may pin placement but never name a backend the
+    health loop does not watch).  Raises ValueError on a malformed
+    address, an id outside MODEL_ID_RE, a duplicate backend or
+    override, or an override whose target is not a listed backend."""
+    backends: List[str] = []
+    overrides: Dict[str, str] = {}
+    for entry in entries:
+        mid, sep, addr = str(entry).partition("=")
+        if not sep:
+            mid, addr = "", mid
+        mid, addr = mid.strip(), addr.strip()
+        host, hsep, port = addr.rpartition(":")
+        if not hsep or not host or not port.isdigit() or not (
+                0 < int(port) <= 65535):
+            raise ValueError(
+                f"route_backends entry {entry!r} is not 'host:port' or "
+                "'model_id=host:port'")
+        if not mid:
+            if addr in backends:
+                raise ValueError(
+                    f"route_backends backend {addr!r} appears twice")
+            backends.append(addr)
+        else:
+            if not MODEL_ID_RE.match(mid):
+                raise ValueError(
+                    f"route_backends override id {mid!r} must match "
+                    "[A-Za-z0-9._-]{1,64}")
+            if mid in overrides:
+                raise ValueError(
+                    f"route_backends override for {mid!r} appears twice")
+            overrides[mid] = addr
+    for mid, addr in overrides.items():
+        if addr not in backends:
+            raise ValueError(
+                f"route_backends override {mid}={addr} names a backend "
+                "that is not listed as a bare host:port entry")
+    return tuple(backends), overrides
+
+
 # the sparse_store dial's legal values — binned-store layout
 # (docs/Sparse.md): "csr" keeps per-row (store column, bin) nonzero
 # entries and the histogram kernels iterate only stored entries;
@@ -188,6 +234,17 @@ PARAM_ALIASES: Dict[str, str] = {
     "canary_requests": "serve_shadow_requests",
     "shadow_max_divergence": "serve_shadow_max_divergence",
     "canary_max_divergence": "serve_shadow_max_divergence",
+    # router tier (task=route, lightgbm_tpu/router/, docs/Router.md)
+    "router_backends": "route_backends",
+    "backends": "route_backends",
+    "router_port": "route_port",
+    "routing_port": "route_port",
+    "router_health_interval_ms": "route_health_interval_ms",
+    "route_health_ms": "route_health_interval_ms",
+    "router_backend_timeout_ms": "route_backend_timeout_ms",
+    "backend_timeout_ms": "route_backend_timeout_ms",
+    "router_max_inflight": "route_max_inflight",
+    "route_inflight_cap": "route_max_inflight",
     # online learning (task=online / task=refit, lightgbm_tpu/online/)
     "decay_rate": "refit_decay_rate",
     "refit_decay": "refit_decay_rate",
@@ -550,6 +607,30 @@ class Config:
     serve_shadow_requests: int = 32
     serve_shadow_max_divergence: float = -1.0
 
+    # -- router tier (task=route, lightgbm_tpu/router/, docs/Router.md)
+    # the backend fleet the router fronts: bare `host:port` entries are
+    # backend serving processes; `model_id=host:port` entries are
+    # explicit placement overrides pinning a tenant to one of the
+    # listed backends (parse_route_backends is the grammar).  Unpinned
+    # tenants place by consistent hash of their model id, so adding or
+    # removing one backend moves only that backend's tenants.
+    route_backends: Tuple[str, ...] = tuple()
+    # listen port of the router's own HTTP front (task=route).
+    route_port: int = 8180
+    # period of the router's backend health probes (GET /healthz on
+    # every backend).  A probe answering readmits an open-breaker
+    # backend exactly like a successful proxied request.  0 = no
+    # background probing (count-based half-open probes on live traffic
+    # still readmit — the chaos-deterministic path).
+    route_health_interval_ms: float = 1000.0
+    # per-attempt socket timeout for proxied backend requests AND
+    # health probes; a timeout counts as a breaker failure.
+    route_backend_timeout_ms: float = 30000.0
+    # router-wide in-flight request cap: beyond it new requests shed
+    # load with HTTP 503 + Retry-After instead of stacking threads on
+    # slow backends.  0 = unbounded.
+    route_max_inflight: int = 0
+
     # -- fault tolerance (task=train checkpoint/resume, docs/Robustness.md)
     # when set, training snapshots (model + iteration + early-stopping +
     # sampler RNG state) to this path every `checkpoint_interval`
@@ -612,7 +693,8 @@ class Config:
 _FIELD_TYPES = {f.name: f.type for f in dataclasses.fields(Config)}
 _TUPLE_INT_FIELDS = {"ndcg_eval_at", "mesh_shape"}
 _TUPLE_FLOAT_FIELDS = {"label_gain"}
-_TUPLE_STR_FIELDS = {"valid_data", "metric", "serve_models"}
+_TUPLE_STR_FIELDS = {"valid_data", "metric", "serve_models",
+                     "route_backends"}
 
 
 def apply_aliases(params: Dict[str, Any]) -> Dict[str, Any]:
@@ -749,6 +831,17 @@ def check_param_conflict(cfg: Config) -> None:
         raise ValueError("serve_shadow_fraction must be in [0, 1]")
     if cfg.serve_shadow_requests < 1:
         raise ValueError("serve_shadow_requests must be >= 1")
+    if cfg.route_backends:
+        parse_route_backends(cfg.route_backends)  # host:port + override shape
+    if not (0 <= cfg.route_port <= 65535):
+        raise ValueError("route_port must be in [0, 65535]")
+    if cfg.route_health_interval_ms < 0:
+        raise ValueError("route_health_interval_ms must be >= 0 (0 = "
+                         "probe only on live traffic)")
+    if cfg.route_backend_timeout_ms <= 0:
+        raise ValueError("route_backend_timeout_ms must be > 0")
+    if cfg.route_max_inflight < 0:
+        raise ValueError("route_max_inflight must be >= 0 (0 = unbounded)")
     if not (0.0 <= cfg.refit_decay_rate <= 1.0):
         raise ValueError("refit_decay_rate must be in [0, 1]")
     if cfg.refit_min_rows < 0:

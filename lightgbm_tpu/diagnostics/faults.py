@@ -35,6 +35,9 @@ Sites wired into the package (docs/Robustness.md has the full table):
 - ``train.after_checkpoint`` — crash just after a checkpoint landed
 - ``serve.dispatch`` / ``serve.dispatch.r<N>`` — replica dispatch (any /
   replica N) raises before executing
+- ``route.backend`` / ``route.backend.b<N>`` — router→backend round-trip
+  (any / backend N) raises before connecting: covers proxied requests,
+  health probes, and stats fetches
 - ``online.before_publish`` — crash after refresh compute, before the
   model/meta renames
 - ``online.publish_model`` — published model file torn mid-write, crash

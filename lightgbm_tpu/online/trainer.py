@@ -62,7 +62,7 @@ from ..dataset import Dataset as RawDataset
 from ..diagnostics import faults
 from ..log import LightGBMError
 from .refit import LeafRefitter
-from .stream import TrafficLog
+from .stream import TrafficDemux, TrafficLog
 
 STATE_VERSION = 1
 
@@ -88,7 +88,7 @@ class OnlineTrainer:
     def __init__(self, booster, traffic_path: str, publish_path: str, *,
                  config: Optional[Config] = None, reference=None,
                  resume: bool = True, model_id: Optional[str] = None,
-                 match_unkeyed: Optional[bool] = None):
+                 match_unkeyed: Optional[bool] = None, traffic=None):
         cfg = config or config_from_params(booster.params)
         if not booster._gbdt.models:
             raise LightGBMError("task=online needs a trained input model")
@@ -102,11 +102,16 @@ class OnlineTrainer:
         self.model_id = model_id
         # pin the traffic row width to the model's feature count so a
         # single malformed-width line can never become the yardstick
-        # that rejects the valid rows behind it
-        self.traffic = TrafficLog(traffic_path,
-                                  expected_features=booster.num_feature(),
-                                  model_filter=model_id,
-                                  match_unkeyed=match_unkeyed)
+        # that rejects the valid rows behind it.  `traffic=` injects a
+        # pre-built reader (an OnlineFleet hands each tenant a
+        # TrafficDemux view so the shared tail is parsed once).
+        if traffic is not None:
+            self.traffic = traffic
+        else:
+            self.traffic = TrafficLog(traffic_path,
+                                      expected_features=booster.num_feature(),
+                                      model_filter=model_id,
+                                      match_unkeyed=match_unkeyed)
         self.publish_path = publish_path
         self.state_path = publish_path + ".state.json"
         self.refbin_path = publish_path + ".refbin"
@@ -666,10 +671,11 @@ class OnlineFleet:
     publish path, so crash-safe resume stays per-tenant.  One tenant's
     refresh failure never stalls the others.
 
-    Known limit (ROADMAP item 2): each tenant's TrafficLog parses the
-    WHOLE shared tail independently — poll cost scales with tenants x
-    log bytes.  A single demuxing reader feeding per-tenant buffers is
-    the follow-on once tenant counts grow past a handful.
+    The shared tail is read and parsed ONCE per poll cycle: the fleet
+    builds a single `TrafficDemux` over the traffic file and hands each
+    tenant's daemon a per-tenant view (same TrafficLog surface, so
+    crash-safe offset resume is unchanged).  Poll cost scales with log
+    bytes, not tenants x log bytes.
     """
 
     def __init__(self, trainers: List[OnlineTrainer]):
@@ -692,6 +698,10 @@ class OnlineFleet:
         models = catalog_models_from_config(cfg)
         unkeyed_owner = ("default" if "default" in models
                          else next(iter(models)))
+        # ONE tailer for the whole fleet: each tenant gets a demux view
+        # instead of an independent TrafficLog, so the shared file is
+        # read and JSON-parsed once per poll cycle
+        demux = TrafficDemux(cfg.data)
         trainers = []
         for mid, path in models.items():
             # each tenant's model path is both the daemon's input and
@@ -703,7 +713,11 @@ class OnlineFleet:
                               model_file=path)
             trainers.append(OnlineTrainer(
                 booster, cfg.data, path, config=tcfg, model_id=mid,
-                match_unkeyed=(mid == unkeyed_owner)))
+                match_unkeyed=(mid == unkeyed_owner),
+                traffic=demux.view(
+                    model_filter=mid,
+                    match_unkeyed=(mid == unkeyed_owner),
+                    expected_features=booster.num_feature())))
         log.info(f"online fleet: {len(trainers)} tenant daemons "
                  f"({', '.join(models)}) sharing {cfg.data}")
         return cls(trainers)

@@ -129,6 +129,31 @@ SERVE_SHADOW_SCORED = "serve/shadow_scored"
 SERVE_SHADOW_ADOPTIONS = "serve/shadow_adoptions"
 SERVE_SHADOW_REJECTIONS = "serve/shadow_rejections"
 
+# Canonical router-tier counters (docs/Router.md), fed through count()
+# by the task=route process fronting M backend serving processes:
+#  - ROUTER_REQUESTS: /predict requests accepted by the router (the
+#    per-model and per-backend labeled series ride the same base name).
+#  - ROUTER_RETRIES: proxied dispatches that failed at the transport
+#    layer and were re-run once on a different healthy backend (the
+#    router-scope analogue of serve.chunk_retries).
+#  - ROUTER_REJECTED: requests shed with 503 — the `route_max_inflight`
+#    admission cap, or no healthy backend placeable for the model.
+#  - ROUTER_BACKEND_FAILURES / ROUTER_BACKEND_BROKEN /
+#    ROUTER_BACKEND_READMITTED / ROUTER_BACKEND_PROBES: per-event
+#    breaker transitions of the per-backend circuit breakers (the PR 9
+#    replica state machine one level up).
+#  - ROUTER_REHASHES: requests whose placement (override target or
+#    ring-home backend) was open-breaker and re-placed onto the next
+#    healthy backend clockwise — the drain-re-placement churn metric.
+ROUTER_REQUESTS = "router/requests"
+ROUTER_RETRIES = "router/retries"
+ROUTER_REJECTED = "router/rejected"
+ROUTER_BACKEND_FAILURES = "router/backend_failures"
+ROUTER_BACKEND_BROKEN = "router/backend_broken"
+ROUTER_BACKEND_READMITTED = "router/backend_readmitted"
+ROUTER_BACKEND_PROBES = "router/backend_probes"
+ROUTER_REHASHES = "router/rehashes"
+
 # Every canonical counter constant of this module, in one tuple: the
 # Prometheus exposition (telemetry.prometheus_text) seeds each of these
 # at 0 so a scrape always covers the full canonical set, and the
@@ -142,6 +167,9 @@ CANONICAL_COUNTERS = (
     SERVE_QUANTIZE_BYTES_IN, SERVE_BINNED_REQUESTS,
     SERVE_CACHE_EVICTIONS, SERVE_SHADOW_SCORED, SERVE_SHADOW_ADOPTIONS,
     SERVE_SHADOW_REJECTIONS,
+    ROUTER_REQUESTS, ROUTER_RETRIES, ROUTER_REJECTED,
+    ROUTER_BACKEND_FAILURES, ROUTER_BACKEND_BROKEN,
+    ROUTER_BACKEND_READMITTED, ROUTER_BACKEND_PROBES, ROUTER_REHASHES,
 )
 
 

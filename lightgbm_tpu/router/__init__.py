@@ -1,0 +1,20 @@
+"""Router tier: horizontal scale-out across M serving processes.
+
+``task=route`` runs a stdlib-only HTTP router (docs/Router.md) that
+spreads /predict traffic over M backend ``task=serve`` processes:
+consistent-hash tenant→backend placement (with explicit overrides),
+per-backend circuit breakers with count-based half-open probes, and
+fleet-aggregated /stats + /metrics.
+"""
+from .placement import HashRing
+from .server import (BackendState, NoHealthyBackendError, RouterServer,
+                     route_from_config, router_from_config)
+
+__all__ = [
+    "BackendState",
+    "HashRing",
+    "NoHealthyBackendError",
+    "RouterServer",
+    "route_from_config",
+    "router_from_config",
+]

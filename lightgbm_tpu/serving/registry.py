@@ -181,6 +181,15 @@ class ModelRegistry:
         return load_refbin(self.model_path + ".refbin",
                            expected_sha1=expected)
 
+    def pending_publish(self) -> bool:
+        """True when the model file on disk no longer matches the
+        signature the live generation loaded — a publish has landed
+        that this process has not swapped in yet (poll window), or
+        refused (swap failure).  /healthz reports tenants in this state
+        as ``stale`` so the router tier's health probes can tell a
+        partially-swapped backend from a live one (docs/Router.md)."""
+        return _file_signature(self.model_path) != self._sig
+
     def _publish_trace_id(self) -> Optional[str]:
         """The publishing refresh's trace id from the online trainer's
         ``.meta.json`` sidecar (None for models published any other

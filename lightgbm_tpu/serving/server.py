@@ -35,12 +35,13 @@ import json
 import re
 import threading
 from concurrent.futures import TimeoutError as _FutureTimeout
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Optional, Tuple
 
 import numpy as np
 
 from .. import log, profiling, telemetry
+from ..httpd import SeveringHTTPServer
 from ..config import MODEL_ID_RE, Config, parse_serve_models
 from ..log import LightGBMError
 from .batcher import ServerOverloadedError
@@ -100,30 +101,57 @@ _TRACE_ID_RE = re.compile(r"^[A-Za-z0-9._:-]{1,128}$")
 class _Handler(BaseHTTPRequestHandler):
     server_version = "lightgbm-tpu-serve"
     protocol_version = "HTTP/1.1"
+    # response headers + payload leave in separate small writes; with
+    # Nagle on, that write-write pattern can stall a whole delayed-ACK
+    # period (~40ms) per request at the tail — TCP_NODELAY is table
+    # stakes for a latency-gated scoring endpoint
+    disable_nagle_algorithm = True
 
     def log_message(self, fmt, *args):       # route per-request chatter
         log.debug(f"http {fmt % args}")      # away from stderr
 
     def _respond(self, code: int, payload: bytes,
-                 content_type: str = "application/json") -> None:
+                 content_type: str = "application/json",
+                 headers: Tuple[Tuple[str, str], ...] = ()) -> None:
         self.send_response(code)
         self.send_header("Content-Type", content_type)
+        for k, v in headers:
+            self.send_header(k, v)
         self.send_header("Content-Length", str(len(payload)))
         self.end_headers()
         self.wfile.write(payload)
 
-    def _respond_json(self, code: int, obj) -> None:
-        self._respond(code, (json.dumps(obj) + "\n").encode())
+    def _respond_json(self, code: int, obj,
+                      headers: Tuple[Tuple[str, str], ...] = ()) -> None:
+        self._respond(code, (json.dumps(obj) + "\n").encode(),
+                      headers=headers)
 
     def do_GET(self):
         srv: "PredictionServer" = self.server.prediction_server
         path = self.path.split("?", 1)[0]
         if path == "/healthz":
+            # liveness PLUS swap freshness: live generations per tenant,
+            # the published generation each model's .meta.json sidecar
+            # names on disk, and the tenants whose on-disk model no
+            # longer matches the loaded signature ("stale" — a pending
+            # or refused swap).  The router tier's health probe
+            # (lightgbm_tpu/router/) reads these to tell a
+            # partially-swapped backend from a healthy one.
+            models, published, stale = {}, {}, []
+            for mid in srv.catalog.ids():
+                reg = srv.catalog.get(mid).registry
+                models[mid] = reg.generation
+                meta = srv._read_json_sidecar(
+                    reg.model_path + ".meta.json", "online meta")
+                published[mid] = (meta or {}).get("generation")
+                if reg.pending_publish():
+                    stale.append(mid)
             self._respond_json(200, {
                 "status": "ok",
                 "generation": srv.registry.generation,
-                "models": {mid: srv.catalog.get(mid).registry.generation
-                           for mid in srv.catalog.ids()}})
+                "models": models,
+                "published": published,
+                "stale": stale})
         elif path == "/stats":
             self._respond_json(200, srv.stats())
         elif path == "/metrics":
@@ -212,8 +240,11 @@ class _Handler(BaseHTTPRequestHandler):
             return
         except (ServerOverloadedError, NoHealthyReplicaError) as e:
             # shed load: admission control or a fully circuit-broken
-            # fleet — 503 tells the client to retry, unlike a raw 500
-            self._respond_json(503, {"error": str(e)})
+            # fleet — 503 tells the client to retry, unlike a raw 500,
+            # and Retry-After paces router- and client-level backoff so
+            # a recovering fleet is not hammered flat
+            self._respond_json(503, {"error": str(e)},
+                               headers=(("Retry-After", "1"),))
             return
         except LightGBMError as e:
             self._respond_json(400, {"error": str(e)})
@@ -268,8 +299,7 @@ class PredictionServer:
         # /predict waiters give up (HTTP 504) after this long; the
         # Config key is serve_request_timeout_ms
         self.request_timeout_s = max(float(request_timeout_ms), 1.0) / 1e3
-        self._httpd = ThreadingHTTPServer((host, port), _Handler)
-        self._httpd.daemon_threads = True
+        self._httpd = SeveringHTTPServer((host, port), _Handler)
         self._httpd.prediction_server = self
         self.host, self.port = self._httpd.server_address[:2]
         self._stop = threading.Event()
@@ -439,6 +469,10 @@ class PredictionServer:
     def stop(self) -> None:
         self._stop.set()
         self._httpd.shutdown()
+        # sever established keep-alive connections so an in-process
+        # stop looks like a process kill to clients holding pooled
+        # connections (the router's breaker contract depends on it)
+        self._httpd.close_client_connections()
         self._httpd.server_close()
         self.catalog.close()
         for t in self._threads:

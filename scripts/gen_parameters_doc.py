@@ -207,6 +207,41 @@ def main():
         "divergence exceeds this (`>= 0`); negative = log-only, "
         "always adopt after the quorum.",
         "",
+        "## Routing",
+        "",
+        "- `route_backends` (default empty, aliases `router_backends`, "
+        "`backends`): the serving fleet behind `task=route` — "
+        "comma-separated `host:port` backends, plus optional "
+        "`model_id=host:port` entries that pin a tenant's placement "
+        "(an explicit override beats the consistent-hash ring).  "
+        "Unpinned tenants place by consistent hash, so adding or "
+        "removing one backend re-places only the tenants that hashed "
+        "onto it.  See docs/Router.md.",
+        "- `route_port` (default `8180`, aliases `router_port`, "
+        "`routing_port`): the router's listen port (listen host comes "
+        "from `serve_host`).",
+        "- `route_health_interval_ms` (default `1000`, aliases "
+        "`router_health_interval_ms`, `route_health_ms`): period of "
+        "the background `/healthz` sweep over every backend — probe "
+        "successes readmit circuit-broken backends, probe failures "
+        "open breakers without waiting for live traffic, and the "
+        "parsed payloads feed the fleet staleness view at `/stats`.  "
+        "`0` = no background sweep (the count-based live-traffic "
+        "probes still readmit).",
+        "- `route_backend_timeout_ms` (default `30000`, aliases "
+        "`router_backend_timeout_ms`, `backend_timeout_ms`): "
+        "per-dispatch socket timeout toward a backend; a timeout is a "
+        "transport failure — it counts toward the backend's breaker "
+        "and the request retries once elsewhere.",
+        "- `route_max_inflight` (default `0`, aliases "
+        "`router_max_inflight`, `route_inflight_cap`): cap on "
+        "concurrently proxied requests; past it the router sheds with "
+        "HTTP 503 + `Retry-After` instead of stacking proxy threads "
+        "on slow backends.  `0` = unbounded.",
+        "- the router's breaker threshold is `replica_failure_"
+        "threshold` — the serving fleet's replica state machine one "
+        "level up, sharing its knob.",
+        "",
         "## Online learning",
         "",
         "- `refit_decay_rate` (default `0.9`, aliases `decay_rate`, "

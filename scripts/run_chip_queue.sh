@@ -73,6 +73,11 @@ stage bench_online env BENCH_SANITIZE=1 BENCH_ONLINE_OUT=bench_online_measured.j
 # gated on bitwise answers, recovery, and 0 request-path compiles /
 # 0 retraces / 0 implicit transfers — refreshes the committed artifact
 stage bench_chaos env BENCH_SANITIZE=1 BENCH_CHAOS_OUT=bench_chaos_measured.json python scripts/bench_chaos.py || exit 1
+# router tier: sustained-QPS overhead of the routing hop vs direct
+# backend access (<5% p99 inflation gate) + the chaos drill one level
+# up — backend killed mid-load, zero failed client requests, breaker
+# opens, restart readmits — refreshes the committed artifact
+stage bench_router env BENCH_SANITIZE=1 BENCH_ROUTER_OUT=bench_router_measured.json python scripts/bench_router.py || exit 1
 # streamed-vs-monolithic ingestion: peak RSS bounded by stream_chunk_rows
 # (not N), streamed store bitwise == batch within the sample budget,
 # streamed-store training sanitized at 0 retraces / 0 implicit transfers

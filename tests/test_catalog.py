@@ -768,6 +768,38 @@ def test_online_fleet_includes_default_tenant(tmp_path):
     assert by_id["de"].traffic._match_unkeyed is False
 
 
+# -- /healthz swap freshness (the router tier's probe payload) -----------
+
+
+def test_healthz_published_and_stale_for_router_probe(tmp_path):
+    """/healthz names, per tenant, the LIVE generation, the PUBLISHED
+    generation from the on-disk .meta.json sidecar, and the tenants
+    whose on-disk model no longer matches the loaded bytes — the
+    payload the router's health probe reads to tell a stale or
+    partially-swapped backend from a healthy one."""
+    bst, _X = _train_binary(features=6)
+    pa, pb = str(tmp_path / "a.txt"), str(tmp_path / "b.txt")
+    bst.save_model(pa)
+    bst.save_model(pb)
+    with open(pa + ".meta.json", "w") as f:
+        json.dump({"generation": 5, "model_id": "a"}, f)
+    cat = ModelCatalog({"a": pa, "b": pb}, params={"verbose": -1},
+                       max_batch_rows=64)
+    srv = PredictionServer(catalog=cat, model_poll_seconds=0)
+    with srv:
+        health = _get_json(srv.host, srv.port, "/healthz")
+        assert health["models"] == {"a": 1, "b": 1}
+        assert health["published"] == {"a": 5, "b": None}
+        assert health["stale"] == []
+        # republish b on disk; with polling off the swap is PENDING —
+        # exactly what the router must see as staleness
+        bst2, _ = _train_binary(num_leaves=31, seed=99, features=6)
+        _save(bst2, pb)
+        health = _get_json(srv.host, srv.port, "/healthz")
+        assert health["stale"] == ["b"]
+        assert health["models"]["b"] == 1     # old generation still live
+
+
 # -- config keys ---------------------------------------------------------
 
 

@@ -775,6 +775,31 @@ def test_server_503_when_zero_replicas_healthy(tmp_path):
         faults.reset()
 
 
+def test_server_503_carries_retry_after(tmp_path):
+    """Shed-load answers (zero healthy replicas, admission overload)
+    carry Retry-After so well-behaved clients — including the router
+    tier — back off instead of hammering a convalescing server."""
+    srv, X = _server(tmp_path, replicas=1, failure_threshold=1,
+                     flush_deadline_ms=1.0)
+    try:
+        body = json.dumps({"rows": X[:4].tolist()})
+        faults.arm("serve.dispatch")
+        _http(srv, "POST", "/predict", body)    # breaks the one replica
+        faults.disarm()
+        conn = http.client.HTTPConnection(srv.host, srv.port, timeout=30)
+        try:
+            conn.request("POST", "/predict", body=body)
+            r = conn.getresponse()
+            r.read()
+            assert r.status == 503
+            assert r.getheader("Retry-After") == "1"
+        finally:
+            conn.close()
+    finally:
+        srv.stop()
+        faults.reset()
+
+
 def test_server_504_on_request_timeout(tmp_path):
     """serve_request_timeout_ms bounds the waiter: a batch that has not
     scored in time answers 504 (retry with backoff), not a raw 500."""

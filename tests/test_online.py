@@ -251,6 +251,148 @@ def test_traffic_log_truncation_restarts(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# multi-tenant demux: one tailer, per-tenant views
+# ---------------------------------------------------------------------------
+
+
+def _mixed_tenant_log(path):
+    """default-tenant, 'de', 'fr', one bad line, one wrong-width row."""
+    with open(path, "w") as f:
+        f.write(json.dumps({"features": [1.0, 2.0], "label": 0.0}) + "\n")
+        f.write(json.dumps({"features": [3.0, 4.0], "label": 1.0,
+                            "model": "de", "weight": 2.0,
+                            "trace_id": "t1"}) + "\n")
+        f.write("not json\n")
+        f.write(json.dumps({"features": [5.0, 6.0], "label": 0.5,
+                            "model": "fr"}) + "\n")
+        f.write(json.dumps({"features": [7.0], "label": 1.0,
+                            "model": "de"}) + "\n")
+
+
+def test_traffic_demux_views_match_independent_readers(tmp_path):
+    """Counter-for-counter parity with the N-independent-readers world:
+    a demux view with a given tenant filter reports EXACTLY what a
+    standalone TrafficLog with the same filter reports — offsets, rows,
+    bad lines, filtered rows — while the file is parsed once."""
+    from lightgbm_tpu.online.stream import TrafficDemux
+    path = str(tmp_path / "traffic.jsonl")
+    _mixed_tenant_log(path)
+    dm = TrafficDemux(path)
+    views = {
+        "default": dm.view(model_filter="default", match_unkeyed=True,
+                           expected_features=2),
+        "de": dm.view(model_filter="de", expected_features=2),
+        "fr": dm.view(model_filter="fr", expected_features=2),
+    }
+    got = views["default"].read_new()
+    assert got[0].tolist() == [[1.0, 2.0]] and got[2] is None
+    got = views["de"].read_new()
+    assert got[0].tolist() == [[3.0, 4.0]]
+    assert got[2].tolist() == [2.0]
+    assert views["de"].last_trace_ids == ["t1"]
+    assert views["fr"].read_new()[0].tolist() == [[5.0, 6.0]]
+    # every view replayed past every record: the window is pruned empty
+    assert len(dm._records) == 0
+    # incremental append reaches only the keyed tenant
+    append_traffic(path, np.array([[9.0, 10.0]]), np.array([1.0]),
+                   model_id="de")
+    assert views["fr"].read_new() is None
+    assert views["de"].read_new()[0].tolist() == [[9.0, 10.0]]
+    assert views["default"].read_new() is None
+    # parity: a fresh standalone TrafficLog with the same filter agrees
+    # on every counter (match_unkeyed defaulting included)
+    for mid, view in views.items():
+        tl = TrafficLog(path, expected_features=2, model_filter=mid,
+                        match_unkeyed=(mid == "default"))
+        while tl.read_new() is not None:
+            pass
+        assert tl.counters() == view.counters(), mid
+
+
+def test_traffic_demux_rotation_and_backward_seek(tmp_path):
+    """A rotated file restarts exactly the views that were past it, and
+    one view's resume-seek below the window rewinds the shared parse
+    cursor without replaying rows into the other views."""
+    from lightgbm_tpu.online.stream import TrafficDemux
+    path = str(tmp_path / "traffic.jsonl")
+    _mixed_tenant_log(path)
+    dm = TrafficDemux(path)
+    v_de = dm.view(model_filter="de", expected_features=2)
+    v_fr = dm.view(model_filter="fr", expected_features=2)
+    assert len(v_de.read_new()[0]) == 1
+    assert len(v_fr.read_new()[0]) == 1
+    with open(path, "w") as f:                      # rotation
+        f.write(json.dumps({"features": [0.0, 0.0], "label": 9.0,
+                            "model": "de"}) + "\n")
+    assert v_de.read_new()[1][0] == 9.0
+    assert v_fr.read_new() is None
+    assert v_fr.offset == os.path.getsize(path)
+    # crash-safe resume: v_de seeks back to 0 (as _try_resume would)
+    # and re-reads ITS row; v_fr sees nothing new
+    v_de.seek(0)
+    assert v_de.read_new()[1][0] == 9.0
+    assert v_fr.read_new() is None
+
+
+def test_traffic_demux_overcap_line_charges_every_view(tmp_path):
+    """A single line larger than the poll cap is skipped once by the
+    tailer and charged to EVERY view — the same evidence N independent
+    readers would each have recorded."""
+    from lightgbm_tpu.online.stream import TrafficDemux
+    path = str(tmp_path / "traffic.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"features": [1.0] * 200, "label": 1.0,
+                            "model": "de"}) + "\n")
+        f.write(json.dumps({"features": [1.0, 2.0], "label": 1.0,
+                            "model": "de"}) + "\n")
+    dm = TrafficDemux(path, max_poll_bytes=256)
+    v_de = dm.view(model_filter="de", expected_features=2)
+    v_fr = dm.view(model_filter="fr", expected_features=2)
+    rows = 0
+    for _ in range(50):
+        got = v_de.read_new()
+        if got is not None:
+            rows += len(got[0])
+        v_fr.read_new()
+    assert rows == 1
+    # each capped slice of the giant line charges every view, exactly
+    # as a standalone reader charges itself per capped poll
+    tl = TrafficLog(path, expected_features=2, model_filter="de",
+                    max_poll_bytes=256)
+    for _ in range(50):          # capped polls return None mid-drain
+        tl.read_new()
+    for v in (v_de, v_fr):
+        assert v.overcap_skips == tl.overcap_skips >= 1
+        assert v.bad_lines == tl.bad_lines > v.overcap_skips
+
+
+def test_online_fleet_trainers_share_one_demux(tmp_path):
+    """OnlineFleet.from_config hands every tenant daemon a view of ONE
+    shared TrafficDemux (the poll-cost-scales-with-bytes contract)."""
+    from lightgbm_tpu.config import config_from_params
+    from lightgbm_tpu.online.stream import TrafficDemuxView
+    from lightgbm_tpu.online.trainer import OnlineFleet
+    X, y = _synth(60, f=6)
+    bst = _train(X, y, {"num_leaves": 7})
+    paths = {}
+    for mid in ("de", "fr"):
+        p = str(tmp_path / f"{mid}.txt")
+        bst.save_model(p)
+        paths[mid] = p
+    traffic = str(tmp_path / "t.jsonl")
+    open(traffic, "w").close()
+    cfg = config_from_params({
+        "task": "online", "verbose": -1, "data": traffic,
+        "serve_models": [f"{mid}={p}" for mid, p in paths.items()],
+        "online_trigger_rows": 32})
+    fleet = OnlineFleet.from_config(cfg)
+    views = [t.traffic for t in fleet.trainers]
+    assert all(isinstance(v, TrafficDemuxView) for v in views)
+    assert len({id(v._demux) for v in views}) == 1
+    assert views[0]._demux.path == traffic
+
+
+# ---------------------------------------------------------------------------
 # leaf-index routing parity (walk vs tensorized) — the refit router
 # ---------------------------------------------------------------------------
 
