@@ -34,20 +34,99 @@ SERVE_QUANTIZE_MODES = ("auto", "binned", "raw")
 MODEL_ID_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
 
 
-def parse_serve_models(entries) -> Dict[str, str]:
-    """``("de=/models/de.txt", "fr=/models/fr.txt")`` → ordered
-    ``{id: model path}``.  The ONE place the `serve_models` grammar
-    lives — config validation, `task=serve` catalog construction, and
-    the `task=online` per-tenant daemon fleet all route through here.
-    Raises ValueError on a missing ``=``, an id outside MODEL_ID_RE,
-    an empty path, or a duplicate id."""
-    out: Dict[str, str] = {}
+class ServeModelEntry(str):
+    """One parsed `serve_models` entry: the model PATH (this object IS
+    the path — a str subclass, so every caller that treats catalog
+    values as path strings keeps working) plus the tenant's validated
+    per-tenant overrides dict (possibly empty)."""
+    __slots__ = ("overrides",)
+
+    def __new__(cls, path: str, overrides: Optional[dict] = None):
+        self = super().__new__(cls, path)
+        self.overrides = dict(overrides or {})
+        return self
+
+    @property
+    def path(self) -> str:
+        return str(self)
+
+
+# the per-tenant keys a `serve_models` entry may override after its
+# path (docs/serving.md "Cross-model batching"), normalized to the
+# catalog's kwarg names; every alias of the fleet-wide parameter is
+# accepted so `de=/m/de.txt;num_replicas=2` means what the operator
+# expects
+_SERVE_OVERRIDE_KEYS: Dict[str, str] = {
+    "replicas": "replicas",
+    "serve_replicas": "replicas",
+    "serving_replicas": "replicas",
+    "num_replicas": "replicas",
+    "serve_quantize": "serve_quantize",
+    "max_pending_rows": "max_pending_rows",
+    "costack": "costack",
+    "serve_costack": "costack",
+    "cross_model_batching": "costack",
+}
+
+_BOOL_WORDS = {"true": True, "on": True, "1": True, "yes": True,
+               "false": False, "off": False, "0": False, "no": False}
+
+
+def _parse_serve_override(entry, key: str, value: str):
+    """Validate + coerce ONE `;key=value` tenant override."""
+    canon = _SERVE_OVERRIDE_KEYS.get(key)
+    if canon is None:
+        raise ValueError(
+            f"serve_models entry {entry!r}: unknown per-tenant "
+            f"override {key!r}; use one of "
+            f"{sorted(set(_SERVE_OVERRIDE_KEYS.values()))}")
+    if canon in ("replicas", "max_pending_rows"):
+        try:
+            n = int(value)
+        except ValueError:
+            raise ValueError(
+                f"serve_models entry {entry!r}: {key}={value!r} "
+                "is not an integer")
+        if n < 0:
+            raise ValueError(
+                f"serve_models entry {entry!r}: {key} must be >= 0")
+        return canon, n
+    if canon == "serve_quantize":
+        if value not in SERVE_QUANTIZE_MODES:
+            raise ValueError(
+                f"serve_models entry {entry!r}: serve_quantize="
+                f"{value!r}; use one of {SERVE_QUANTIZE_MODES}")
+        return canon, value
+    b = _BOOL_WORDS.get(str(value).strip().lower())
+    if b is None:
+        raise ValueError(
+            f"serve_models entry {entry!r}: {key}={value!r} is not "
+            "a boolean (true/false/on/off/1/0)")
+    return canon, b
+
+
+def parse_serve_models(entries) -> Dict[str, "ServeModelEntry"]:
+    """``("de=/models/de.txt", "fr=/models/fr.txt;replicas=2")`` →
+    ordered ``{id: ServeModelEntry}`` (the value IS the model path — a
+    str subclass — carrying a validated per-tenant ``overrides`` dict).
+    The ONE place the `serve_models` grammar lives — config validation,
+    `task=serve` catalog construction, and the `task=online` per-tenant
+    daemon fleet all route through here.  Grammar per entry:
+    ``id=path[;key=value]...`` with override keys ``replicas``,
+    ``serve_quantize``, ``max_pending_rows``, ``costack`` (fleet-wide
+    parameter aliases accepted).  Raises ValueError on a missing ``=``,
+    an id outside MODEL_ID_RE, an empty path, a duplicate id, or a
+    malformed override."""
+    out: Dict[str, ServeModelEntry] = {}
     for entry in entries:
-        mid, sep, path = str(entry).partition("=")
-        mid, path = mid.strip(), path.strip()
+        mid, sep, rest = str(entry).partition("=")
+        mid = mid.strip()
+        path, *extras = rest.split(";")
+        path = path.strip()
         if not sep or not path:
             raise ValueError(
-                f"serve_models entry {entry!r} is not 'id=path'")
+                f"serve_models entry {entry!r} is not "
+                "'id=path[;key=value]'")
         if not MODEL_ID_RE.match(mid):
             raise ValueError(
                 f"serve_models id {mid!r} must match "
@@ -60,7 +139,21 @@ def parse_serve_models(entries) -> Dict[str, str]:
             # publishes and resume offsets
             raise ValueError(
                 f"serve_models path {path!r} appears under two ids")
-        out[mid] = path
+        overrides: Dict[str, object] = {}
+        for extra in extras:
+            k, ksep, v = extra.partition("=")
+            k, v = k.strip(), v.strip()
+            if not ksep or not k or not v:
+                raise ValueError(
+                    f"serve_models entry {entry!r}: override "
+                    f"{extra!r} is not 'key=value'")
+            canon, coerced = _parse_serve_override(entry, k, v)
+            if canon in overrides:
+                raise ValueError(
+                    f"serve_models entry {entry!r}: override "
+                    f"{canon!r} appears twice")
+            overrides[canon] = coerced
+        out[mid] = ServeModelEntry(path, overrides)
     return out
 
 
@@ -234,6 +327,8 @@ PARAM_ALIASES: Dict[str, str] = {
     "canary_requests": "serve_shadow_requests",
     "shadow_max_divergence": "serve_shadow_max_divergence",
     "canary_max_divergence": "serve_shadow_max_divergence",
+    "costack": "serve_costack",
+    "cross_model_batching": "serve_costack",
     # router tier (task=route, lightgbm_tpu/router/, docs/Router.md)
     "router_backends": "route_backends",
     "backends": "route_backends",
@@ -595,6 +690,16 @@ class Config:
     # serve/cache_evictions counts the churn).  The most recently used
     # tenant is never evicted.  0 = unlimited.
     serve_cache_budget_mb: int = 0
+    # cross-model batched serving (docs/serving.md "Cross-model
+    # batching"): co-stack catalog tenants that share (num_class,
+    # serve_quantize variant, leaf tier) onto ONE padded super-stack
+    # scored by ONE compiled executable per (bucket, kind) — a mixed
+    # batch of many tenants costs one device launch, bitwise-identical
+    # to per-tenant dispatch.  Off = every tenant keeps its own
+    # executables (the PR 15 layout).  Tenants opt out individually
+    # with a `;costack=off` entry override, and a per-tenant
+    # `;replicas=` override also forces that tenant solo.
+    serve_costack: bool = True
     # shadow-canary publishes: with a fraction > 0, a republished model
     # is STAGED as a candidate instead of swapped live — this fraction
     # of requests is double-scored on it (stable still answers the

@@ -227,6 +227,21 @@ class EnsembleMeta(NamedTuple):
     any_cat: bool          # ensemble has categorical splits
 
 
+class GroupMeta(NamedTuple):
+    """Static companions of a cross-model SUPER-STACK: N tenants'
+    ensembles concatenated along the tree axis (tenant-major, each
+    tenant's trees class-major like its solo stack), scored for a mixed
+    batch in ONE launch.  ``segments[g] = (start, stop)`` bounds tenant
+    g's trees in the stack — static at trace time, so the per-tenant
+    reductions slice and reduce exactly the tree set (same shape, same
+    op) the tenant's SOLO stack would, which is what makes grouped
+    scoring bitwise-identical to per-tenant dispatch."""
+    depth: int             # levels to walk (max over every tenant)
+    num_class: int         # K — shared by every tenant in the group
+    any_cat: bool          # any tenant has categorical splits
+    segments: tuple        # ((start, stop), ...) tree bounds per tenant
+
+
 # perfect relayout budget: total value-slab slots (T * 2^depth) above
 # which the padded-SoA traversal takes over — 2^22 slots is ~50 MB of
 # node records at the default, far above the north-star 500-tree
@@ -350,6 +365,17 @@ def stack_ensemble(trees_by_class, *, binned: bool, _shape=None
         raise ValueError("stack_ensemble needs at least one tree")
     m, depth, any_cat = _shape or _ensemble_shape(flat, binned)
     meta = EnsembleMeta(depth=depth, num_class=num_class, any_cat=any_cat)
+    nodes, lv, root, cls = _fill_stack(flat, m, binned)
+    stack = EnsembleStack(nodes=_maybe_narrow(nodes, binned),
+                          leaf_value=lv, root=root, class_id=cls)
+    return stack, meta
+
+
+def _fill_stack(flat, m: int, binned: bool):
+    """The node/leaf fill over a class-major ``[(class, tree)]`` flatten
+    — ONE loop shared by `stack_ensemble` and `stack_ensemble_group`, so
+    a solo stack and a super-stack can never encode the same tree
+    differently."""
     T = len(flat)
     dtype = np.int32 if binned else np.float32
     nodes = np.zeros((T, m - 1, _LANES), dtype)
@@ -375,17 +401,60 @@ def stack_ensemble(trees_by_class, *, binned: bool, _shape=None
             nodes[i, :knodes, 2] = t.decision_type[:knodes]
         nodes[i, :knodes, 3] = t.left_child[:knodes]
         nodes[i, :knodes, 4] = t.right_child[:knodes]
+    return nodes, lv, root, cls
+
+
+def _maybe_narrow(nodes: np.ndarray, binned: bool) -> np.ndarray:
+    """The integer record narrows to int16 whenever every lane fits
+    (bins < 2^15, children/features < 2^15 — always, outside the
+    trivial-feature rebin sentinels): half the record-gather bytes per
+    depth level on the binned serving request path.  TPU only — CPU
+    XLA's int16 gathers de-vectorize (measured 1.5x slower than the
+    int32 record at the north-star shape)."""
     if binned and nodes.size and jax.default_backend() == "tpu" and \
             -0x8000 <= int(nodes.min()) and int(nodes.max()) < 0x8000:
-        # the integer record narrows to int16 whenever every lane fits
-        # (bins < 2^15, children/features < 2^15 — always, outside the
-        # trivial-feature rebin sentinels): half the record-gather
-        # bytes per depth level on the binned serving request path.
-        # TPU only — CPU XLA's int16 gathers de-vectorize (measured
-        # 1.5x slower than the int32 record at the north-star shape)
-        nodes = nodes.astype(np.int16)
-    stack = EnsembleStack(nodes=nodes, leaf_value=lv, root=root,
-                          class_id=cls)
+        return nodes.astype(np.int16)
+    return nodes
+
+
+def stack_ensemble_group(members, *, binned: bool = False
+                         ) -> tuple[EnsembleStack, GroupMeta]:
+    """Co-stack N tenants' ensembles into ONE super-stack.
+
+    ``members`` is a list of per-tenant ``trees_by_class`` lists (the
+    same shape `stack_ensemble` takes), all with the SAME class count.
+    Trees flatten tenant-major (each tenant's trees class-major, i.e.
+    exactly its solo stack order) into one padded [T_total, nodes] SoA;
+    ``meta.segments`` records each tenant's static tree bounds so
+    `_grouped_sums` can reduce per tenant with the solo reduction.
+    Node records pad to the WIDEST tree across the group and the walk
+    runs to the DEEPEST tenant's depth — a parked row no-ops through
+    surplus levels, so padding changes no routing decision, only the
+    launch's node-record footprint (the grouping policy in
+    serving/catalog.py bounds that waste by leaf-budget tier).
+    """
+    if not members:
+        raise ValueError("stack_ensemble_group needs at least one member")
+    ks = {len(tbc) for tbc in members}
+    if len(ks) != 1:
+        raise ValueError("co-stacked members must share num_class "
+                         f"(got {sorted(ks)})")
+    num_class = ks.pop()
+    flat = []
+    segments = []
+    for tbc in members:
+        start = len(flat)
+        flat.extend((k, t) for k, trees in enumerate(tbc) for t in trees)
+        if len(flat) == start:
+            raise ValueError("every co-stacked member needs at least "
+                             "one tree")
+        segments.append((start, len(flat)))
+    m, depth, any_cat = _ensemble_shape(flat, binned)
+    meta = GroupMeta(depth=depth, num_class=num_class, any_cat=any_cat,
+                     segments=tuple(segments))
+    nodes, lv, root, cls = _fill_stack(flat, m, binned)
+    stack = EnsembleStack(nodes=_maybe_narrow(nodes, binned),
+                          leaf_value=lv, root=root, class_id=cls)
     return stack, meta
 
 
@@ -404,21 +473,18 @@ def _leaf_sums(stack: EnsembleStack, node: jax.Array, num_class: int
                                indices_are_sorted=True)
 
 
-@functools.partial(jax.jit, static_argnames=("meta",))
-def predict_ensemble(stack: EnsembleStack, X: jax.Array, *,
-                     meta: EnsembleMeta) -> jax.Array:
-    """Raw per-class scores over raw feature values — [K, N] f32.
-
-    All N rows x T trees advance one depth level per step: one batched
-    record gather, one feature gather, one select.  `meta.depth` loop
-    iterations total for the whole ensemble (the walk kernel runs a
-    depth-loop per class and five gathers per level).
-
-    Decision parity with `_walk_one_tree` is bitwise: numerical ``v <=
-    t`` (NaN falls right), categorical int-truncation compare behind
-    the host walk's finite mask (non-finite never matches).
-    """
-    Xf = X.astype(jnp.float32)
+def _walk_raw_nodes(stack: EnsembleStack, Xf: jax.Array, meta
+                    ) -> jax.Array:
+    """The raw-feature ensemble walk itself: parked node per (tree, row)
+    — [T, N] int32, leaves encoded as ~leaf.  Shared by the value kernel
+    (`predict_ensemble`), the leaf-index kernel
+    (`predict_ensemble_leaf`), and the grouped super-stack kernel
+    (`predict_ensemble_grouped`) so they can never disagree on a routing
+    decision.  Decision parity with `_walk_one_tree` is bitwise:
+    numerical ``v <= t`` (NaN falls right), categorical int-truncation
+    compare behind the host walk's finite mask (tree.py
+    predict_leaf_index — non-finite never matches; a bare int cast of
+    NaN is backend-defined)."""
     T = stack.nodes.shape[0]
     N = Xf.shape[0]
     rows = jnp.arange(N)[None, :]
@@ -432,10 +498,6 @@ def predict_ensemble(stack: EnsembleStack, X: jax.Array, *,
         t = rec[..., 1]
         gl = v <= t
         if meta.any_cat:
-            # categorical: int truncation compare with the host walk's
-            # explicit finite mask (tree.py predict_leaf_index), same
-            # as predict_ensemble_leaf — value and leaf kernels must
-            # agree on every routing decision, NaN rows included
             finite = jnp.isfinite(v)
             vi = jnp.where(finite, v, -1.0).astype(jnp.int32)
             gl = jnp.where(rec[..., 2] > 0,
@@ -443,7 +505,20 @@ def predict_ensemble(stack: EnsembleStack, X: jax.Array, *,
         nxt = jnp.where(gl, rec[..., 3], rec[..., 4]).astype(jnp.int32)
         return jnp.where(node >= 0, nxt, node)
 
-    node = jax.lax.fori_loop(0, meta.depth, step, node)
+    return jax.lax.fori_loop(0, meta.depth, step, node)
+
+
+@functools.partial(jax.jit, static_argnames=("meta",))
+def predict_ensemble(stack: EnsembleStack, X: jax.Array, *,
+                     meta: EnsembleMeta) -> jax.Array:
+    """Raw per-class scores over raw feature values — [K, N] f32.
+
+    All N rows x T trees advance one depth level per step: one batched
+    record gather, one feature gather, one select.  `meta.depth` loop
+    iterations total for the whole ensemble (the walk kernel runs a
+    depth-loop per class and five gathers per level).
+    """
+    node = _walk_raw_nodes(stack, X.astype(jnp.float32), meta)
     return _leaf_sums(stack, node, meta.num_class)
 
 
@@ -622,34 +697,86 @@ def predict_ensemble_leaf(stack: EnsembleStack, X: jax.Array, *,
                           meta: EnsembleMeta) -> jax.Array:
     """Per-tree leaf index over RAW feature values — [T, N] int32.
 
-    The tensorized `pred_leaf` kernel.  Decision parity is with the
-    HOST walk (tree.py predict_leaf_index), which is the `walk` kernel
-    for leaf output: numerical ``v <= t`` (f32 — NaN falls right),
-    categorical compares via the host's explicit finite mask
-    (non-finite NEVER matches a category; a bare int cast of NaN is
-    backend-defined and silently diverges from the host on NaN rows —
-    the divergence the walk/tensorized parity test pins down).
+    The tensorized `pred_leaf` kernel: exactly the walk
+    `predict_ensemble` sums values over (`_walk_raw_nodes`), returning
+    the parked leaf instead — the divergence the walk/tensorized parity
+    test pins down cannot reappear while the walk is shared.
     """
-    Xf = X.astype(jnp.float32)
-    T = stack.nodes.shape[0]
-    N = Xf.shape[0]
-    rows = jnp.arange(N)[None, :]
-    node = jnp.broadcast_to(stack.root[:, None], (T, N))
-
-    def step(_, node):
-        safe = jnp.maximum(node, 0)
-        rec = jnp.take_along_axis(stack.nodes, safe[:, :, None], axis=1)
-        f = rec[..., 0].astype(jnp.int32)
-        v = Xf[rows, f]                                  # [T, N]
-        t = rec[..., 1]
-        gl = v <= t
-        if meta.any_cat:
-            finite = jnp.isfinite(v)
-            vi = jnp.where(finite, v, -1.0).astype(jnp.int32)
-            gl = jnp.where(rec[..., 2] > 0,
-                           finite & (vi == t.astype(jnp.int32)), gl)
-        nxt = jnp.where(gl, rec[..., 3], rec[..., 4]).astype(jnp.int32)
-        return jnp.where(node >= 0, nxt, node)
-
-    node = jax.lax.fori_loop(0, meta.depth, step, node)
+    node = _walk_raw_nodes(stack, X.astype(jnp.float32), meta)
     return jnp.where(node < 0, ~node, 0)
+
+
+# ----------------------------------------------------------------------
+# grouped (cross-model) traversal — N co-stacked tenants, ONE launch
+# ----------------------------------------------------------------------
+
+def _grouped_sums(stack: EnsembleStack, node: jax.Array,
+                  tids: jax.Array, meta: GroupMeta) -> jax.Array:
+    """[K, N] per-class sums where row n sums ONLY the trees of its own
+    tenant ``tids[n]``.
+
+    The walk above parked every row in every tree (rows do visit
+    wrong-tenant trees — those trees gather whichever of the row's
+    features their splits name, park somewhere, and are discarded
+    here).  Each tenant's reduction is a STATIC slice of the [T, N]
+    leaf values (`meta.segments` — trace-time bounds) fed to the SAME
+    op and shape `_leaf_sums` uses on the tenant's solo stack: plain
+    ``sum(axis=0)`` for K==1, sorted segment-sum over class_id for
+    K>1.  Same addends in the same reduction ⇒ bitwise-identical to
+    per-tenant dispatch — which is why this is G static slices and NOT
+    one masked segment-sum over the concatenated stack (a different
+    accumulation order/shape XLA may reassociate differently).
+    The final per-row select is a gather over the [G, K, N] stack of
+    per-tenant answers; an out-of-range tid clamps (JAX gather
+    semantics) rather than reading garbage.
+    """
+    leaf = jnp.where(node < 0, ~node, 0)
+    vals = jnp.take_along_axis(stack.leaf_value, leaf, axis=1)   # [T, N]
+    per = []
+    for a, b in meta.segments:
+        seg = vals[a:b]
+        if meta.num_class == 1:
+            per.append(jnp.sum(seg, axis=0)[None])
+        else:
+            per.append(jax.ops.segment_sum(seg, stack.class_id[a:b],
+                                           num_segments=meta.num_class,
+                                           indices_are_sorted=True))
+    sums = jnp.stack(per)                                  # [G, K, N]
+    idx = jnp.broadcast_to(tids.astype(jnp.int32)[None, None, :],
+                           (1,) + sums.shape[1:])
+    return jnp.take_along_axis(sums, idx, axis=0)[0]       # [K, N]
+
+
+@functools.partial(jax.jit, static_argnames=("meta",))
+def predict_ensemble_grouped(stack: EnsembleStack, X: jax.Array,
+                             tids: jax.Array, *,
+                             meta: GroupMeta) -> jax.Array:
+    """Mixed-tenant raw scores over raw features — [K, N] f32.
+
+    One walk of the whole super-stack (every row through every tenant's
+    trees — the walk is gather-bound, so surplus trees ride the same
+    depth loop), then per-tenant reductions and a per-row tenant
+    select.  ``tids``: [N] int — row n's segment index into
+    ``meta.segments``.  Bitwise-identical to scoring each row through
+    its tenant's solo stack (`_grouped_sums`).
+    """
+    node = _walk_raw_nodes(stack, X.astype(jnp.float32), meta)
+    return _grouped_sums(stack, node, tids, meta)
+
+
+@functools.partial(jax.jit, static_argnames=("meta",))
+def predict_ensemble_grouped_binned(stack: EnsembleStack, Xb: jax.Array,
+                                    tids: jax.Array, *,
+                                    meta: GroupMeta) -> jax.Array:
+    """Mixed-tenant raw scores over ingress-quantized bin ids — [K, N]
+    f32 from [N, F] uint8/uint16 ORIGINAL per-feature bin ids.  The
+    serving request path under serve_quantize=binned for co-stacked
+    tenants: the shared binned walk (`_walk_binned_nodes`, integer
+    compares end to end) over the super-stack, then the same per-tenant
+    demuxed reduction as the raw grouped kernel.  Every tenant's buffer
+    columns must be padded to the group-wide max feature count (the
+    group runtime pads; surplus columns are never gathered by that
+    tenant's trees, and wrong-tenant trees' gathers are discarded).
+    """
+    node = _walk_binned_nodes(stack, Xb, None, meta)
+    return _grouped_sums(stack, node, tids, meta)

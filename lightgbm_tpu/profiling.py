@@ -129,6 +129,17 @@ SERVE_SHADOW_SCORED = "serve/shadow_scored"
 SERVE_SHADOW_ADOPTIONS = "serve/shadow_adoptions"
 SERVE_SHADOW_REJECTIONS = "serve/shadow_rejections"
 
+# Cross-model co-stacked serving (serving/superstack.py,
+# docs/serving.md "Cross-model batching"):
+#  - SERVE_GROUP_COMPILES: XLA compilations charged to a GROUP's shared
+#    super-stack executable (the per-group labeled series rides the
+#    same name) — the quantity co-stacking divides by the group size.
+#  - SERVE_GROUP_RESTACKS: super-stack rebuilds after a member tenant's
+#    hot swap (cache-transplanting restacks included; only restacks
+#    whose program changed also show up as group compiles).
+SERVE_GROUP_COMPILES = "serve/group_compiles"
+SERVE_GROUP_RESTACKS = "serve/group_restacks"
+
 # Canonical router-tier counters (docs/Router.md), fed through count()
 # by the task=route process fronting M backend serving processes:
 #  - ROUTER_REQUESTS: /predict requests accepted by the router (the
@@ -166,7 +177,7 @@ CANONICAL_COUNTERS = (
     SERVE_REPLICA_BROKEN, SERVE_REPLICA_READMITTED, SERVE_REPLICA_PROBES,
     SERVE_QUANTIZE_BYTES_IN, SERVE_BINNED_REQUESTS,
     SERVE_CACHE_EVICTIONS, SERVE_SHADOW_SCORED, SERVE_SHADOW_ADOPTIONS,
-    SERVE_SHADOW_REJECTIONS,
+    SERVE_SHADOW_REJECTIONS, SERVE_GROUP_COMPILES, SERVE_GROUP_RESTACKS,
     ROUTER_REQUESTS, ROUTER_RETRIES, ROUTER_REJECTED,
     ROUTER_BACKEND_FAILURES, ROUTER_BACKEND_BROKEN,
     ROUTER_BACKEND_READMITTED, ROUTER_BACKEND_PROBES, ROUTER_REHASHES,

@@ -723,6 +723,13 @@ class RouterServer:
             "backends": backs,
             "healthy": len(backs) - len(broken),
             "models": self._fleet_models(),
+            # per-backend co-stack group counts from the health sweep
+            # (serving /healthz "groups"): how many compiled-executable
+            # groups each backend's tenants share — the fleet-wide view
+            # of cross-model batching (docs/serving.md)
+            "groups": {addr: (snap["health"] or {}).get("groups")
+                       for addr, snap in backs.items()
+                       if snap["health"] is not None},
             "overrides": dict(self.overrides),
             "inflight": self._inflight,
             "max_inflight": self.max_inflight,

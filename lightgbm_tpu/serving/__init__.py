@@ -21,6 +21,11 @@ Four layers, composed bottom-up:
   batcher) on one fleet — per-model routing/SLO accounting/admission
   budgets, LRU compiled-executable eviction under
   `serve_cache_budget_mb`, cross-tenant fault isolation.
+- `superstack` — GroupRuntime: cross-model batched serving — tenants
+  sharing (num_class, kernel variant, leaf tier) co-stack onto ONE
+  padded super-stack scored by ONE compiled executable per (bucket,
+  kind); mixed batches demux bitwise-identically to per-tenant
+  dispatch (`serve_costack`, docs/serving.md "Cross-model batching").
 - `server`   — PredictionServer: stdlib JSON-lines HTTP endpoint
   (/predict with `model` routing, /healthz, /stats, /metrics), the
   `task=serve` CLI entry.
@@ -30,11 +35,13 @@ from .runtime import (OUTPUT_KINDS, PredictorRuntime,
 from .batcher import MicroBatcher, ServerOverloadedError
 from .registry import ModelRegistry
 from .catalog import DEFAULT_MODEL_ID, ModelCatalog, UnknownModelError
+from .superstack import GroupRuntime, costack_key
 from .server import PredictionServer, serve_from_config, server_from_config
 
 __all__ = [
     "OUTPUT_KINDS", "PredictorRuntime", "resolve_serve_replicas",
     "row_bucket", "MicroBatcher", "ServerOverloadedError", "ModelRegistry",
     "DEFAULT_MODEL_ID", "ModelCatalog", "UnknownModelError",
+    "GroupRuntime", "costack_key",
     "PredictionServer", "serve_from_config", "server_from_config",
 ]

@@ -50,11 +50,12 @@ class ServerOverloadedError(LightGBMError):
 
 class _Request:
     __slots__ = ("X", "kind", "future", "t_enqueue", "trace_id",
-                 "parent_id")
+                 "parent_id", "model_id")
 
     def __init__(self, X: np.ndarray, kind: str,
                  trace_id: Optional[str] = None,
-                 parent_id: Optional[str] = None):
+                 parent_id: Optional[str] = None,
+                 model_id: Optional[str] = None):
         self.X = X
         self.kind = kind
         self.future: Future = Future()
@@ -64,6 +65,11 @@ class _Request:
         # ride the request object explicitly
         self.trace_id = trace_id
         self.parent_id = parent_id
+        # originating tenant on a SHARED (cross-model) batcher: selects
+        # the request's tree segment in the group runtime and charges
+        # its labeled accounting series.  None on per-tenant batchers
+        # (the batcher-level model_id covers every request).
+        self.model_id = model_id
 
 
 class MicroBatcher:
@@ -78,7 +84,8 @@ class MicroBatcher:
     def __init__(self, source, *, max_batch_rows: int = 4096,
                  flush_deadline_ms: float = 5.0, workers: int = 1,
                  max_pending_rows: int = 0,
-                 model_id: Optional[str] = None):
+                 model_id: Optional[str] = None,
+                 pending_caps: Optional[dict] = None):
         self._source = source
         self.max_batch_rows = max(1, int(max_batch_rows))
         self.flush_deadline_s = max(0.0, float(flush_deadline_ms)) / 1e3
@@ -92,6 +99,13 @@ class MicroBatcher:
         self.model_id = model_id
         self._labels = ({"model": model_id} if model_id is not None
                         else None)
+        # SHARED (cross-model) batcher: admission stays PER TENANT —
+        # each tenant's pending rows are tracked separately and checked
+        # against its own cap (``pending_caps`` override, else
+        # ``max_pending_rows``), so a hot tenant saturating the shared
+        # queue sheds ITS load while quiet neighbors keep admitting
+        self.pending_caps = dict(pending_caps or {})
+        self._pending_by_model: dict = {}
         self._cond = threading.Condition()
         self._queue: Deque[_Request] = deque()
         self._rows_pending = 0
@@ -109,18 +123,26 @@ class MicroBatcher:
 
     def submit(self, X: np.ndarray, kind: str = "value",
                trace_id: Optional[str] = None,
-               parent_id: Optional[str] = None) -> Future:
+               parent_id: Optional[str] = None,
+               model_id: Optional[str] = None) -> Future:
         """Enqueue one request; the Future resolves to its predictions
         (Booster.predict shapes) or raises the scoring error.
         ``trace_id``/``parent_id`` tie the request's dispatch records to
-        the caller's span (the HTTP handler passes its ingress ids)."""
+        the caller's span (the HTTP handler passes its ingress ids).
+        ``model_id`` names the originating tenant on a shared
+        cross-model batcher (admission and accounting stay per
+        tenant)."""
         X = np.ascontiguousarray(np.asarray(X, np.float64))
         if X.ndim == 1:
             X = X.reshape(1, -1)
         if X.ndim != 2 or X.shape[0] == 0:
             raise LightGBMError("predict request must be a non-empty "
                                 "[rows, features] matrix")
-        req = _Request(X, kind, trace_id, parent_id)
+        mid = model_id if model_id is not None else self.model_id
+        labels = ({"model": mid} if mid is not None else None)
+        cap = (self.pending_caps.get(mid, self.max_pending_rows)
+               if mid is not None else self.max_pending_rows)
+        req = _Request(X, kind, trace_id, parent_id, model_id)
         with self._cond:
             if self._closed:
                 raise LightGBMError("batcher is closed")
@@ -128,39 +150,53 @@ class MicroBatcher:
             # already at/over the cap, so a single request larger than
             # the cap still lands on an idle server (the runtime chunks
             # arbitrarily large batches); the queue stays bounded by
-            # cap + one request
-            if (self.max_pending_rows
-                    and self._rows_pending >= self.max_pending_rows):
+            # cap + one request.  On a shared batcher the check runs
+            # against the TENANT's own pending rows.
+            pending = (self._pending_by_model.get(mid, 0)
+                       if model_id is not None else self._rows_pending)
+            if cap and pending >= cap:
                 self.rejected += 1
                 profiling.count("serve.rejected")
-                if self._labels:
+                if labels:
                     profiling.count(profiling.labeled("serve.rejected",
-                                                      **self._labels))
+                                                      **labels))
                 raise ServerOverloadedError(
-                    f"serving queue full ({self._rows_pending} rows "
-                    f"pending, cap {self.max_pending_rows}"
-                    + (f", model {self.model_id}" if self.model_id
-                       else "") + "); retry later")
+                    f"serving queue full ({pending} rows "
+                    f"pending, cap {cap}"
+                    + (f", model {mid}" if mid else "") + "); retry later")
             self._queue.append(req)
             self._rows_pending += X.shape[0]
+            if model_id is not None:
+                self._pending_by_model[model_id] = (
+                    self._pending_by_model.get(model_id, 0) + X.shape[0])
             depth = len(self._queue)
             self._cond.notify_all()
         profiling.count("serve.requests")
         profiling.observe("serve.queue_depth", depth)
-        if self._labels:
+        if labels:
             profiling.count(profiling.labeled("serve.requests",
-                                              **self._labels))
+                                              **labels))
             profiling.count(profiling.labeled("serve.rows",
-                                              **self._labels),
+                                              **labels),
                             X.shape[0])
             profiling.observe(profiling.labeled("serve.queue_depth",
-                                                **self._labels), depth)
+                                                **labels), depth)
         return req.future
 
     @property
     def queue_depth(self) -> int:
         with self._cond:
             return len(self._queue)
+
+    def pending_rows_for(self, model_id: str) -> int:
+        """One tenant's pending rows on a shared batcher (its /stats
+        queue view — the global queue_depth spans every tenant)."""
+        with self._cond:
+            return self._pending_by_model.get(model_id, 0)
+
+    def cap_for(self, model_id: str) -> int:
+        """One tenant's admission cap (override or the shared default)."""
+        return self.pending_caps.get(model_id, self.max_pending_rows)
 
     def close(self) -> None:
         """Stop accepting work, flush what is queued, join the threads."""
@@ -204,6 +240,13 @@ class MicroBatcher:
                     break
                 req = self._queue.popleft()
                 rows += req.X.shape[0]
+                if req.model_id is not None:
+                    left = (self._pending_by_model.get(req.model_id, 0)
+                            - req.X.shape[0])
+                    if left > 0:
+                        self._pending_by_model[req.model_id] = left
+                    else:
+                        self._pending_by_model.pop(req.model_id, None)
                 batch.append(req)
             self._rows_pending -= rows
             return batch
@@ -225,6 +268,9 @@ class MicroBatcher:
         except Exception as e:                     # registry load failure
             for req in batch:
                 req.future.set_exception(e)
+            return
+        if hasattr(runtime, "predict_mixed"):
+            self._flush_mixed(batch, runtime)
             return
         self.batches_flushed += 1
         profiling.count("serve.batches")
@@ -285,4 +331,91 @@ class MicroBatcher:
                 except Exception as e:  # noqa: BLE001 — the canary
                     # must never take the flusher down
                     log.warning(f"shadow scoring failed: "
+                                f"{type(e).__name__}: {e}")
+
+    def _flush_mixed(self, batch: List[_Request], runtime) -> None:
+        """Dispatch one CROSS-MODEL batch on a GroupRuntime: every
+        request carries its tenant, the group scores the mixed rows in
+        one launch per chunk, and the demuxed per-request answers are
+        charged — latency, dispatch events, shadow comparisons — to
+        each request's OWN tenant, never to the group."""
+        self.batches_flushed += 1
+        profiling.count("serve.batches")
+        # group by kind only: member widths differ legitimately (each
+        # request validates against its own tenant's feature contract
+        # inside predict_mixed), so width is not a batching boundary
+        groups: dict = {}
+        for req in batch:
+            groups.setdefault(req.kind, []).append(req)
+        for kind, reqs in groups.items():
+            jobs = []
+            routable = []
+            for req in reqs:
+                g = runtime.member_index.get(req.model_id)
+                if g is None:
+                    # the tenant left this group between enqueue and
+                    # flush (a restack regrouped it) — fail THIS
+                    # request; the client's retry re-routes correctly
+                    req.future.set_exception(LightGBMError(
+                        f"model {req.model_id!r} is no longer served "
+                        "by this co-stack group; retry"))
+                    continue
+                jobs.append((g, req.X))
+                routable.append(req)
+            if not jobs:
+                continue
+            rows = int(sum(X.shape[0] for _g, X in jobs))
+            leader = routable[0]
+            try:
+                with telemetry.span(
+                        "serve.batch", trace_id=leader.trace_id,
+                        parent_id=leader.parent_id, kind=kind,
+                        rows=rows, requests=len(routable),
+                        group=runtime.model_id):
+                    outs = runtime.predict_mixed(jobs, kind=kind)
+            except Exception as e:
+                for req in routable:
+                    req.future.set_exception(e)
+                continue
+            now = _now()
+            generation = getattr(runtime, "generation", 0)
+            for req, out in zip(routable, outs):
+                req.future.generation = generation
+                req.future.set_result(out)
+                wait_ms = (now - req.t_enqueue) * 1e3
+                profiling.observe("serve.latency_ms", wait_ms)
+                if req.model_id is not None:
+                    profiling.observe(
+                        profiling.labeled("serve.latency_ms",
+                                          model=req.model_id), wait_ms)
+                telemetry.event(
+                    "serve.dispatch", trace_id=req.trace_id,
+                    parent_id=req.parent_id, rows=req.X.shape[0],
+                    kind=kind, generation=generation,
+                    model=req.model_id, group=runtime.model_id,
+                    batch_trace=leader.trace_id,
+                    batch_requests=len(routable),
+                    wait_ms=round(wait_ms, 3))
+            # per-MEMBER shadow canaries, after every future resolved:
+            # each tenant's staged candidate double-scores only its own
+            # rows, against its own stable answers, on its own solo
+            # candidate runtime — a neighbor's canary never sees this
+            # tenant's traffic
+            shadow = getattr(self._source, "shadow_member", None)
+            if shadow is None:
+                continue
+            by_member: dict = {}
+            for req, out in zip(routable, outs):
+                by_member.setdefault(req.model_id, []).append(
+                    (req.X, out))
+            for mid, pairs in by_member.items():
+                try:
+                    Xm = (pairs[0][0] if len(pairs) == 1 else
+                          np.concatenate([p[0] for p in pairs], axis=0))
+                    pm = (pairs[0][1] if len(pairs) == 1 else
+                          np.concatenate([p[1] for p in pairs], axis=0))
+                    shadow(mid, Xm, kind, pm, requests=len(pairs))
+                except Exception as e:  # noqa: BLE001 — the canary
+                    # must never take the flusher down
+                    log.warning(f"shadow scoring failed for {mid}: "
                                 f"{type(e).__name__}: {e}")

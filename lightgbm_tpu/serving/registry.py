@@ -75,7 +75,8 @@ class ModelRegistry:
                  model_id: Optional[str] = None,
                  shadow_fraction: float = 0.0,
                  shadow_requests: int = 32,
-                 shadow_max_divergence: float = -1.0):
+                 shadow_max_divergence: float = -1.0,
+                 warm_initial: bool = True):
         from ..config import SERVE_QUANTIZE_MODES
         self.model_path = model_path
         self.params = dict(params or {})
@@ -95,6 +96,15 @@ class ModelRegistry:
         # catalog tenant id (None for plain single-model registries):
         # rides into the runtime's spans and the per-model counters
         self.model_id = model_id
+        # co-stacked tenant (serving/superstack.py): this registry's
+        # solo runtime holds stacks but serves no direct traffic — the
+        # catalog's GroupRuntime does — so warming its executables on
+        # swaps would pay one compile per tenant and defeat the whole
+        # point of grouping.  The catalog flips this after grouping and
+        # warms the GROUP instead (restack path); shadow candidates
+        # compile lazily on their first off-request-path comparison.
+        self.costacked = False
+        self.warmup_buckets = tuple(warmup_buckets)
         # shadow canary (docs/serving.md "Multi-tenant catalog"): with
         # fraction > 0, a republished model is STAGED as a candidate
         # and double-scored on 1/fraction of requests before adoption;
@@ -123,7 +133,8 @@ class ModelRegistry:
         # a minutes-long load/warmup must look changed on the next poll
         self._sig = _file_signature(model_path)
         runtime = self._load(generation=1)
-        runtime.warmup(warmup_buckets, self.warmup_kinds)
+        if warm_initial:
+            runtime.warmup(warmup_buckets, self.warmup_kinds)
         self._runtime = runtime
         self.swaps = 0
         self.swap_failures = 0
@@ -257,11 +268,16 @@ class ModelRegistry:
                     # warm every bucket the outgoing generation served,
                     # for BOTH this registry's warmup kinds and whatever
                     # kinds actually saw traffic (so no post-swap request
-                    # of either output kind compiles on the request path)
-                    buckets = {b for b, _k in old.buckets_compiled()} or {1}
-                    kinds = ({k for _b, k in old.buckets_compiled()}
-                             | set(self.warmup_kinds))
-                    runtime.warmup(sorted(buckets), sorted(kinds))
+                    # of either output kind compiles on the request path).
+                    # Co-stacked tenants skip this: their traffic runs on
+                    # the group's executable, which the catalog restack
+                    # warms (or cache-transplants) after this swap lands.
+                    if not self.costacked:
+                        buckets = ({b for b, _k in old.buckets_compiled()}
+                                   or {1})
+                        kinds = ({k for _b, k in old.buckets_compiled()}
+                                 | set(self.warmup_kinds))
+                        runtime.warmup(sorted(buckets), sorted(kinds))
             except Exception as e:
                 # a corrupt/torn candidate model must be LOUD and
                 # visible at /stats, not a silent skip: exception class

@@ -256,6 +256,18 @@ class PredictorRuntime:
             self._buf_dtype = np.float32
             self._buf_cols = self.num_features
         self._device_value = self._device_value_fn()
+        self._init_fleet(host_stacks, replicas, failure_threshold,
+                         probe_after)
+
+    def _init_fleet(self, host_stacks, replicas: int,
+                    failure_threshold: int,
+                    probe_after: Optional[int]) -> None:
+        """Replica fleet + dispatch bookkeeping, shared verbatim by the
+        cross-model GroupRuntime (serving/superstack.py) — breaker
+        semantics and cache accounting must not fork per runtime
+        flavor."""
+        import jax
+
         # X is donated only where donation is real; on CPU it would just
         # print an "unusable donated buffer" warning per call
         self._donate = jax.default_backend() in ("tpu", "gpu")
@@ -329,6 +341,12 @@ class PredictorRuntime:
         trees_by_class = [
             [gbdt.models[i] for i in range(used) if i % self.K == k]
             for k in range(self.K)]
+        # retained for the cross-model co-stacking overlay
+        # (serving/superstack.py): a GroupRuntime concatenates its
+        # members' trees into one super-stack, and must stack exactly
+        # the tree set this runtime scores solo (binned variants have
+        # already been rebinned in place above)
+        self._trees_by_class = trees_by_class
         if self.variant == "binned":
             stack, meta = build_ensemble(trees_by_class, binned=True)
             self._meta = meta
@@ -405,14 +423,11 @@ class PredictorRuntime:
             return ensemble_raw(stacks, X, depths=depths)
         return fn
 
-    def _build(self, replica: _Replica, bucket: int, kind: str):
-        """AOT-compile the traversal for one (replica, bucket, kind) —
-        the only place an XLA compilation can happen after the runtime
-        is built."""
-        import jax
-        import jax.numpy as jnp
-        from jax.sharding import SingleDeviceSharding
-
+    def _program(self, kind: str):
+        """The traceable program body for one output kind — (stacks, X)
+        -> [K, rows].  GroupRuntime overrides this with the grouped
+        traversal; everything downstream (_build's AOT compile, the
+        executable cache, warmup, dispatch) is shared."""
         raw_fn = self._raw_fn()
         device_value = self._device_value if kind == "value" else None
 
@@ -421,7 +436,17 @@ class PredictorRuntime:
             if device_value is not None:
                 raw = device_value(raw)
             return raw
+        return fn
 
+    def _build(self, replica: _Replica, bucket: int, kind: str):
+        """AOT-compile the traversal for one (replica, bucket, kind) —
+        the only place an XLA compilation can happen after the runtime
+        is built."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import SingleDeviceSharding
+
+        fn = self._program(kind)
         donate = (1,) if self._donate else ()
         x_spec = jax.ShapeDtypeStruct(
             (bucket, self._buf_cols), jnp.dtype(self._buf_dtype),

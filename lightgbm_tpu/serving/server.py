@@ -151,7 +151,10 @@ class _Handler(BaseHTTPRequestHandler):
                 "generation": srv.registry.generation,
                 "models": models,
                 "published": published,
-                "stale": stale})
+                "stale": stale,
+                # co-stack group count: the router's health sweep
+                # surfaces per-backend executable-sharing at /stats
+                "groups": len(srv.catalog._groups)})
         elif path == "/stats":
             self._respond_json(200, srv.stats())
         elif path == "/metrics":
@@ -383,6 +386,10 @@ class PredictionServer:
             "generation": self.registry.generation,
             "default_model": self.catalog.default_id,
             "models": self.catalog.tenant_stats(),
+            # cross-model co-stack groups (docs/serving.md "Cross-model
+            # batching"): which tenants share one compiled executable,
+            # restack/compile churn, shared-fleet health
+            "groups": self.catalog.group_stats(),
             # uptime / RSS / backend / version / telemetry config — the
             # operator's "which process is this" block
             "process": telemetry.process_info(),
@@ -534,7 +541,8 @@ def server_from_config(cfg: Config) -> PredictionServer:
         serve_quantize=cfg.serve_quantize,
         shadow_fraction=cfg.serve_shadow_fraction,
         shadow_requests=cfg.serve_shadow_requests,
-        shadow_max_divergence=cfg.serve_shadow_max_divergence)
+        shadow_max_divergence=cfg.serve_shadow_max_divergence,
+        costack=cfg.serve_costack)
     return PredictionServer(
         catalog=catalog, host=cfg.serve_host, port=cfg.serve_port,
         model_poll_seconds=cfg.model_poll_seconds,

@@ -179,7 +179,13 @@ def main():
         "entry).  Each tenant gets its own registry (hot-swap, shadow "
         "canary, replica breakers), batcher (per-tenant "
         "`max_pending_rows` admission budget), executable caches, and "
-        "per-model `/stats` + labeled `/metrics` accounting.  Also "
+        "per-model `/stats` + labeled `/metrics` accounting.  Entries "
+        "accept per-tenant `;key=value` override suffixes "
+        "(`de=/m/de.txt;replicas=2;costack=off`): `replicas` (pins the "
+        "tenant's fleet size and forces it solo), `serve_quantize`, "
+        "`max_pending_rows`, and `costack=off` — fleet-wide aliases "
+        "work as override keys too, and malformed overrides are "
+        "startup errors.  Also "
         "consumed by `task=online`: one refresh daemon per entry "
         "sharing the traffic tail (keyed rows, keyed publish paths).  "
         "See docs/serving.md \"Multi-tenant catalog\".",
@@ -190,7 +196,21 @@ def main():
         "tenants' executables are evicted (never the most recently "
         "used tenant's; model stacks stay resident, so evicted "
         "tenants keep serving and recompile on their next request — "
-        "`serve/cache_evictions` counts the churn).  `0` = unlimited.",
+        "`serve/cache_evictions` counts the churn).  `0` = unlimited.  "
+        "Under co-stacking a group is ONE eviction unit (recency = its "
+        "most recently used member), so a group is never half-warm.",
+        "- `serve_costack` (default `true`, aliases `costack`, "
+        "`cross_model_batching`): cross-model batched serving — "
+        "catalog tenants sharing (num_class, kernel variant, leaf "
+        "tier) co-stack onto ONE compiled executable per (row bucket, "
+        "output kind); mixed batches coalesce requests across tenants "
+        "into one traversal launch and demux BITWISE-identically to "
+        "per-tenant dispatch.  Tenants with a `replicas` override, "
+        "`costack=off`, or no compatible peer serve solo; a member's "
+        "republish restacks only its group (same-shape republishes "
+        "transplant the compiled executables — zero recompiles).  "
+        "`false` restores the strict per-tenant layout.  See "
+        "docs/serving.md \"Cross-model batching\".",
         "- `serve_shadow_fraction` (default `0.0`, aliases "
         "`shadow_fraction`, `canary_fraction`): shadow-canary "
         "publishes — with a fraction > 0, a republished model is "

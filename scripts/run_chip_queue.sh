@@ -61,7 +61,13 @@ stage bench_serve env BENCH_SANITIZE=1 SERVE_BENCH_SECONDS=10 SERVE_BENCH_REQUIR
 # per-model p99 + /stats accounting, LRU eviction churn under a
 # deliberately tight executable budget, and the per-tenant
 # steady-state sanitize probe (0 retraces / 0 implicit transfers)
-stage bench_serve_mt env BENCH_SANITIZE=1 SERVE_BENCH_TENANTS=3 SERVE_BENCH_SECONDS=8 SERVE_BENCH_CACHE_MB=64 SERVE_BENCH_OUT=.bench/bench_serve_mt.json python scripts/bench_serve.py || exit 1
+stage bench_serve_catalog env BENCH_SANITIZE=1 SERVE_BENCH_TENANTS=3 SERVE_BENCH_SECONDS=8 SERVE_BENCH_CACHE_MB=64 SERVE_BENCH_OUT=.bench/bench_serve_catalog.json python scripts/bench_serve.py || exit 1
+# cross-model co-stack A/B: the same fleet at 10 and 100 tenants with
+# serve_costack off vs on — compiled-executable ratio gated >= 5x,
+# co-stack p99 gated no worse than 1.1x solo, per-tenant answers
+# asserted bitwise equal, 0 request-path compiles on both sides, and
+# the mixed-batch steady-state sanitize probe on the group runtime
+stage bench_serve_mt env BENCH_SANITIZE=1 SERVE_MT_SECONDS=8 SERVE_MT_REQUIRE_RATIO=5 SERVE_MT_REQUIRE_P99=1.1 SERVE_MT_OUT=.bench/bench_serve_mt.json python scripts/bench_serve_mt.py || exit 1
 # online-learning refresh loop at the reduced north-star shape:
 # refit-vs-retrain wall-clock (>= 10x gate) + AUC-after-drift recovery,
 # steady-state refits under the sanitizer (0 retraces / 0 implicit

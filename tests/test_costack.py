@@ -1,0 +1,582 @@
+"""Cross-model batched serving (co-stacking) tests: mixed-batch bitwise
+parity vs per-tenant dispatch, hot-swap restack isolation, executable
+transplant on same-shape republishes, coherent whole-group LRU
+eviction, compatibility fallback to solo, per-tenant override grammar,
+and per-tenant metric attribution of co-stacked batches.
+
+All tier-1, synthetic data only; every catalog tears down in a finally
+block.  The reference point for EVERY parity assertion is the solo
+serving runtime (per-tenant dispatch) — the co-stack contract is
+bitwise equality against exactly that path, which itself may differ
+from the host booster in the last float bit (device f32 transforms).
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import profiling
+from lightgbm_tpu.config import parse_serve_models
+from lightgbm_tpu.log import LightGBMError
+from lightgbm_tpu.serving import GroupRuntime, ModelCatalog, costack_key
+from lightgbm_tpu.serving.runtime import resolve_runtime
+
+pytestmark = pytest.mark.quick
+
+
+def _train(seed, features=10, rounds=4, leaves=15, num_class=None):
+    """One compact model; same (leaves, objective) trains co-stack into
+    the same group, different num_class does not."""
+    rng = np.random.RandomState(seed)
+    X = rng.rand(500, features)
+    if num_class:
+        y = np.argmax(X[:, :num_class] + 0.1 * rng.rand(500, num_class),
+                      axis=1).astype(float)
+        params = {"objective": "multiclass", "num_class": num_class}
+    else:
+        w = rng.randn(features)
+        z = X @ w
+        y = (z > np.median(z)).astype(float)
+        params = {"objective": "binary"}
+    params.update({"num_leaves": leaves, "min_data_in_leaf": 5,
+                   "verbose": -1})
+    ds = lgb.Dataset(X, y)
+    bst = lgb.Booster(params, ds)
+    for _ in range(rounds):
+        bst.update()
+    assert bst.num_trees() > 0
+    return bst, ds, X
+
+
+def _publish(root, mid, seed, refbin=False, **kw):
+    bst, ds, X = _train(seed, **kw)
+    path = str(root / f"{mid}.txt")
+    bst.save_model(path)
+    if refbin:
+        ds.construct()._inner.save_refbin(path + ".refbin")
+    return path, bst, X
+
+
+def _solo(bst, quantize="raw", refbin=None):
+    kw = {"refbin": refbin} if refbin is not None else {}
+    return resolve_runtime(bst, serve_quantize=quantize, **kw)
+
+
+def _mixed_round(cat, jobs, kind="value"):
+    """Submit every tenant's rows concurrently (one forming batch on
+    the shared batcher), then resolve — the mixed-batch path."""
+    futs = {mid: cat.submit(Xm, kind=kind, model_id=mid)[1]
+            for mid, Xm in jobs.items()}
+    return {mid: f.result(timeout=60) for mid, f in futs.items()}
+
+
+# -- tentpole: mixed-batch bitwise parity --------------------------------
+
+
+def test_mixed_batch_bitwise_binary(tmp_path):
+    """Three same-shape binary tenants co-stack into ONE group; a mixed
+    batch answers bitwise-identically to per-tenant (solo) dispatch for
+    both output kinds."""
+    pubs = {mid: _publish(tmp_path, mid, seed)
+            for mid, seed in (("alpha", 11), ("beta", 12), ("gamma", 13))}
+    cat = ModelCatalog({mid: p for mid, (p, _b, _x) in pubs.items()},
+                       params={"verbose": -1}, serve_quantize="raw",
+                       flush_deadline_ms=5.0)
+    try:
+        assert len(cat._groups) == 1
+        (group,) = cat._groups.values()
+        assert sorted(group.member_ids) == ["alpha", "beta", "gamma"]
+        # the models must disagree, or tenant-id demux bugs are invisible
+        Xq = pubs["alpha"][2][:16]
+        pa = pubs["alpha"][1].predict(Xq)
+        pb = pubs["beta"][1].predict(Xq)
+        assert np.abs(pa - pb).max() > 1e-4
+        jobs = {mid: pubs[mid][2][16:16 + 8 + 3 * i]   # uneven row counts
+                for i, mid in enumerate(pubs)}
+        for kind in ("value", "raw"):
+            got = _mixed_round(cat, jobs, kind=kind)
+            for mid, (p, bst, _X) in pubs.items():
+                want = _solo(bst).predict(jobs[mid], kind=kind)
+                assert np.array_equal(got[mid], want), (mid, kind)
+    finally:
+        cat.close()
+
+
+def test_mixed_batch_bitwise_multiclass(tmp_path):
+    """Multiclass (K=3) tenants co-stack and demux bitwise — the
+    per-class segment-sum inside the group kernel must match the solo
+    reduction exactly."""
+    pubs = {mid: _publish(tmp_path, mid, seed, num_class=3)
+            for mid, seed in (("m1", 21), ("m2", 22))}
+    cat = ModelCatalog({mid: p for mid, (p, _b, _x) in pubs.items()},
+                       params={"verbose": -1}, serve_quantize="raw")
+    try:
+        assert len(cat._groups) == 1
+        assert cat._groups[next(iter(cat._groups))].runtime.K == 3
+        jobs = {mid: pubs[mid][2][:12] for mid in pubs}
+        for kind in ("value", "raw"):
+            got = _mixed_round(cat, jobs, kind=kind)
+            for mid, (_p, bst, _X) in pubs.items():
+                want = _solo(bst).predict(jobs[mid], kind=kind)
+                assert got[mid].shape == (12, 3)
+                assert np.array_equal(got[mid], want), (mid, kind)
+    finally:
+        cat.close()
+
+
+def test_mixed_batch_bitwise_binned_heterogeneous_widths(tmp_path):
+    """Binned (quantized ingress) tenants with DIFFERENT feature counts
+    share one group buffer (zero-padded columns) and stay bitwise equal
+    to solo binned dispatch."""
+    pubs = {mid: _publish(tmp_path, mid, seed, refbin=True, features=feat)
+            for mid, (seed, feat) in (("narrow", (31, 8)),
+                                      ("wide", (32, 12)))}
+    cat = ModelCatalog({mid: p for mid, (p, _b, _x) in pubs.items()},
+                       params={"verbose": -1}, serve_quantize="binned")
+    try:
+        assert len(cat._groups) == 1
+        (group,) = cat._groups.values()
+        assert group.runtime.variant == "binned"
+        jobs = {mid: pubs[mid][2][:10] for mid in pubs}
+        for kind in ("value", "raw"):
+            got = _mixed_round(cat, jobs, kind=kind)
+            for mid, (p, bst, _X) in pubs.items():
+                # build the solo reference from the SAME sidecar the
+                # catalog loaded
+                from lightgbm_tpu.quantize import load_refbin
+                rb = load_refbin(p + ".refbin")
+                solo = _solo(bst, quantize="binned", refbin=rb)
+                want = solo.predict(jobs[mid], kind=kind)
+                assert np.array_equal(got[mid], want), (mid, kind)
+    finally:
+        cat.close()
+
+
+# -- compatibility policy ------------------------------------------------
+
+
+def test_incompatible_num_class_falls_back_solo(tmp_path):
+    """A binary and a multiclass tenant never share a stack: no group
+    forms, both serve solo, both answer bitwise."""
+    pb, bb, Xb = _publish(tmp_path, "bin", 41)
+    pm, bm, Xm = _publish(tmp_path, "mc", 42, num_class=3)
+    cat = ModelCatalog({"bin": pb, "mc": pm}, params={"verbose": -1},
+                       serve_quantize="raw")
+    try:
+        assert not cat._groups
+        assert cat.get("bin").group is None
+        got = _mixed_round(cat, {"bin": Xb[:8], "mc": Xm[:8]})
+        assert np.array_equal(got["bin"], _solo(bb).predict(Xb[:8]))
+        assert np.array_equal(got["mc"], _solo(bm).predict(Xm[:8]))
+    finally:
+        cat.close()
+
+
+def test_leaf_tier_partitions_groups(tmp_path):
+    """Tenants whose widest trees land in different power-of-two leaf
+    tiers form DIFFERENT groups (bounded padding waste), same-tier
+    tenants share one."""
+    specs = {"small1": 15, "small2": 13,   # both tier 16
+             "big1": 100, "big2": 120}     # both tier 128
+    pubs = {mid: _publish(tmp_path, mid, 50 + i, leaves=lv, rounds=2)
+            for i, (mid, lv) in enumerate(specs.items())}
+    cat = ModelCatalog({mid: p for mid, (p, _b, _x) in pubs.items()},
+                       params={"verbose": -1}, serve_quantize="raw")
+    try:
+        membership = {frozenset(g.member_ids) for g in cat._groups.values()}
+        assert frozenset(("small1", "small2")) in membership
+        assert frozenset(("big1", "big2")) in membership
+    finally:
+        cat.close()
+
+
+def test_costack_off_keeps_solo_layout(tmp_path):
+    """costack=False restores the PR 15 layout: no groups, one batcher
+    per tenant."""
+    pubs = {mid: _publish(tmp_path, mid, seed)
+            for mid, seed in (("a", 61), ("b", 62))}
+    cat = ModelCatalog({mid: p for mid, (p, _b, _x) in pubs.items()},
+                       params={"verbose": -1}, serve_quantize="raw",
+                       costack=False)
+    try:
+        assert not cat._groups
+        assert cat.get("a").batcher is not cat.get("b").batcher
+    finally:
+        cat.close()
+
+
+# -- hot swap: restack isolation + executable transplant -----------------
+
+
+def test_hot_swap_restacks_only_its_group(tmp_path):
+    """Republishing one member restacks ITS group only: the other
+    group's runtime object and compiled executables are untouched, and
+    its next requests run with ZERO compiles anywhere on the request
+    path."""
+    pubs = {}
+    for mid, seed in (("a1", 71), ("a2", 72)):               # tier 16
+        pubs[mid] = _publish(tmp_path, mid, seed)
+    for mid, seed in (("b1", 73), ("b2", 74)):               # tier 64
+        pubs[mid] = _publish(tmp_path, mid, seed, leaves=60, rounds=2)
+    cat = ModelCatalog({mid: p for mid, (p, _b, _x) in pubs.items()},
+                       params={"verbose": -1}, serve_quantize="raw")
+    try:
+        assert len(cat._groups) == 2
+        by_member = {mid: g for g in cat._groups.values()
+                     for mid in g.member_ids}
+        ga, gb = by_member["a1"], by_member["b1"]
+        assert ga is not gb
+        gb_runtime = gb.runtime
+        jobs = {mid: pubs[mid][2][:8] for mid in pubs}
+        before = _mixed_round(cat, jobs)
+        # republish a1 with a NEW fit (same shape class, fresh trees)
+        bst2, _ds, _X = _train(710)
+        bst2.save_model(pubs["a1"][0])
+        r0 = profiling.counter_value(profiling.SERVE_GROUP_RESTACKS)
+        cat.poll_once()
+        assert (profiling.counter_value(profiling.SERVE_GROUP_RESTACKS)
+                - r0) == 1
+        assert ga.runtime.generation == 2
+        assert gb.runtime is gb_runtime        # b's group never rebuilt
+        # every tenant answers with ZERO request-path compiles: a's
+        # group was restacked + warmed off-path, b's was never touched
+        misses = profiling.counter_value("serve.cache_miss")
+        after = _mixed_round(cat, jobs)
+        assert profiling.counter_value("serve.cache_miss") == misses
+        assert np.array_equal(after["a1"],
+                              _solo(bst2).predict(jobs["a1"]))
+        for mid in ("a2", "b1", "b2"):
+            assert np.array_equal(after[mid], before[mid]), mid
+    finally:
+        cat.close()
+
+
+def test_same_shape_republish_transplants_executables(tmp_path):
+    """A republish that keeps the program signature (the common refit:
+    identical tree shapes) restacks WITHOUT a single compile — the old
+    group's executables transplant onto the new super-stack avals."""
+    pubs = {mid: _publish(tmp_path, mid, seed)
+            for mid, seed in (("a", 81), ("b", 82))}
+    cat = ModelCatalog({mid: p for mid, (p, _b, _x) in pubs.items()},
+                       params={"verbose": -1}, serve_quantize="raw")
+    try:
+        Xq = pubs["a"][2][:8]
+        cat.submit(Xq, model_id="a")[1].result(timeout=60)
+        # the solo reference compiles ITS executable now, so the
+        # zero-compile window below measures only the catalog
+        want = _solo(pubs["a"][1]).predict(Xq)
+        # re-save the SAME model so every tree shape is identical; pad
+        # the file so the registry's (mtime, size) signature moves
+        time.sleep(0.01)
+        with open(pubs["a"][0], "a") as f:
+            f.write("\n")
+        os.utime(pubs["a"][0])
+        misses = profiling.counter_value("serve.cache_miss")
+        r0 = profiling.counter_value(profiling.SERVE_GROUP_RESTACKS)
+        cat.poll_once()
+        assert (profiling.counter_value(profiling.SERVE_GROUP_RESTACKS)
+                - r0) == 1
+        assert profiling.counter_value("serve.cache_miss") == misses
+        got = cat.submit(Xq, model_id="a")[1].result(timeout=60)
+        assert np.array_equal(got, want)
+        assert profiling.counter_value("serve.cache_miss") == misses
+    finally:
+        cat.close()
+
+
+def test_republish_changing_num_class_drops_member_solo(tmp_path):
+    """A member whose republish changes its compatibility key (binary →
+    multiclass) leaves the group and serves solo; the remaining members
+    keep co-stacking (or dissolve to solo when fewer than two stay)."""
+    pubs = {mid: _publish(tmp_path, mid, seed)
+            for mid, seed in (("a", 91), ("b", 92), ("c", 93))}
+    cat = ModelCatalog({mid: p for mid, (p, _b, _x) in pubs.items()},
+                       params={"verbose": -1}, serve_quantize="raw")
+    try:
+        assert len(cat._groups) == 1
+        bmc, _ds, Xmc = _train(910, num_class=3)
+        bmc.save_model(pubs["a"][0])
+        cat.poll_once()
+        assert cat.get("a").group is None          # dropped solo
+        got = cat.submit(Xmc[:8], model_id="a")[1].result(timeout=60)
+        assert np.array_equal(got, _solo(bmc).predict(Xmc[:8]))
+        (group,) = cat._groups.values()            # b, c still grouped
+        assert sorted(group.member_ids) == ["b", "c"]
+        for mid in ("b", "c"):
+            Xq = pubs[mid][2][:8]
+            got = cat.submit(Xq, model_id=mid)[1].result(timeout=60)
+            assert np.array_equal(got, _solo(pubs[mid][1]).predict(Xq))
+    finally:
+        cat.close()
+
+
+# -- LRU budget: groups evict coherently ---------------------------------
+
+
+def test_lru_evicts_whole_group_coherently(tmp_path, monkeypatch):
+    """Under a tight budget the LRU unit is the GROUP: its one shared
+    cache (serving every member) evicts whole, while the MRU solo
+    tenant keeps its executables; the evicted group still answers (it
+    recompiles)."""
+    from lightgbm_tpu.serving.runtime import PredictorRuntime
+    monkeypatch.setattr(PredictorRuntime, "_exe_bytes",
+                        lambda self, exe, bucket: 1 << 20)
+    pubs = {mid: _publish(tmp_path, mid, seed)
+            for mid, seed in (("g1", 95), ("g2", 96))}
+    solo_p, solo_b, solo_X = _publish(tmp_path, "loner", 97, leaves=60,
+                                      rounds=2)                # own tier
+    models = {mid: p for mid, (p, _b, _x) in pubs.items()}
+    models["loner"] = solo_p
+    # 1 MiB budget < the two units' combined working set, so the final
+    # enforcement MUST evict the LRU unit (the group) while the MRU
+    # solo tenant keeps its executable
+    cat = ModelCatalog(models, params={"verbose": -1},
+                       serve_quantize="raw", cache_budget_mb=1)
+    try:
+        assert len(cat._groups) == 1
+        (gid,) = cat._groups
+        group = cat._groups[gid]
+        # touch the group first, the solo tenant LAST (MRU)
+        for mid in ("g1", "g2"):
+            cat.submit(pubs[mid][2][:8], model_id=mid)[1].result(timeout=60)
+        cat.submit(solo_X[:8], model_id="loner")[1].result(timeout=60)
+        cat.enforce_budget()
+        sizes = cat.cache_bytes()
+        assert set(sizes) == {gid, "loner"}     # units, not members
+        assert sizes["loner"] > 0               # MRU unit protected
+        assert sizes[gid] == 0                  # whole group evicted
+        assert group.runtime.cache_bytes() == 0
+        # an evicted group still serves every member (recompile=churn)
+        got = _mixed_round(cat, {mid: pubs[mid][2][:8] for mid in pubs})
+        for mid in pubs:
+            assert np.array_equal(
+                got[mid], _solo(pubs[mid][1]).predict(pubs[mid][2][:8]))
+    finally:
+        cat.close()
+
+
+# -- per-tenant overrides ------------------------------------------------
+
+
+def test_serve_models_override_grammar():
+    m = parse_serve_models((
+        "de=/m/de.txt",
+        "fr=/m/fr.txt;replicas=2;serve_quantize=raw",
+        "us=/m/us.txt;costack=off;max_pending_rows=128",
+        "jp=/m/jp.txt;num_replicas=3;cross_model_batching=on",
+    ))
+    assert m["de"] == "/m/de.txt" and m["de"].overrides == {}
+    assert m["fr"].overrides == {"replicas": 2, "serve_quantize": "raw"}
+    assert m["us"].overrides == {"costack": False,
+                                 "max_pending_rows": 128}
+    # fleet-wide aliases resolve to the canonical override keys
+    assert m["jp"].overrides == {"replicas": 3, "costack": True}
+    # values stay path-string compatible for every existing caller
+    assert os.path.basename(m["fr"]) == "fr.txt"
+    for bad in ("x=/m/x.txt;bogus=1", "x=/m/x.txt;replicas=-1",
+                "x=/m/x.txt;replicas=two", "x=/m/x.txt;serve_quantize=zzz",
+                "x=/m/x.txt;costack=maybe", "x=/m/x.txt;replicas",
+                "x=/m/x.txt;replicas=1;replicas=2"):
+        with pytest.raises(ValueError):
+            parse_serve_models((bad,))
+
+
+def test_override_opts_tenant_out_of_group(tmp_path):
+    """`;costack=off` and `;replicas=` entry overrides force their
+    tenant solo while compatible peers still group; the per-tenant
+    `max_pending_rows` override lands on the shared batcher's
+    admission map."""
+    pubs = {mid: _publish(tmp_path, mid, seed)
+            for mid, seed in (("a", 98), ("b", 99), ("c", 100))}
+    entries = parse_serve_models((
+        f"a={pubs['a'][0]}",
+        f"b={pubs['b'][0]};max_pending_rows=64",
+        f"c={pubs['c'][0]};costack=off",
+    ))
+    cat = ModelCatalog(dict(entries), params={"verbose": -1},
+                       serve_quantize="raw")
+    try:
+        (group,) = cat._groups.values()
+        assert sorted(group.member_ids) == ["a", "b"]
+        assert cat.get("c").group is None
+        assert group.batcher.cap_for("b") == 64
+        assert group.batcher.cap_for("a") == 0      # fleet default
+        got = _mixed_round(cat, {mid: pubs[mid][2][:6] for mid in pubs})
+        for mid in pubs:
+            assert np.array_equal(
+                got[mid], _solo(pubs[mid][1]).predict(pubs[mid][2][:6]))
+    finally:
+        cat.close()
+
+
+def test_per_tenant_admission_on_shared_batcher(tmp_path):
+    """One member at ITS pending-rows cap sheds ITS load with 503
+    semantics; the co-stacked neighbor on the SAME batcher keeps
+    admitting."""
+    from lightgbm_tpu.serving import ServerOverloadedError
+    pubs = {mid: _publish(tmp_path, mid, seed)
+            for mid, seed in (("hot", 101), ("calm", 102))}
+    entries = parse_serve_models((
+        f"hot={pubs['hot'][0]};max_pending_rows=16",
+        f"calm={pubs['calm'][0]}",
+    ))
+    cat = ModelCatalog(dict(entries), params={"verbose": -1},
+                       serve_quantize="raw", max_batch_rows=8)
+    try:
+        (group,) = cat._groups.values()
+        release = threading.Event()
+        orig = group.runtime.predict_mixed
+
+        def slow_mixed(jobs, kind="value"):
+            release.wait(timeout=30)
+            return orig(jobs, kind=kind)
+
+        group.runtime.predict_mixed = slow_mixed
+        try:
+            X = pubs["hot"][2]
+            first = cat.submit(X[:8], model_id="hot")[1]
+            time.sleep(0.2)                  # flusher takes the batch
+            futs = [cat.submit(X[:8], model_id="hot")[1]
+                    for _ in range(2)]       # 16 hot rows pending
+            with pytest.raises(ServerOverloadedError):
+                cat.submit(X[:8], model_id="hot")
+            assert profiling.counter_value(profiling.labeled(
+                "serve.rejected", model="hot")) >= 1
+            # the neighbor shares the batcher but not the cap
+            calm = cat.submit(pubs["calm"][2][:8], model_id="calm")[1]
+        finally:
+            release.set()
+        for f in [first, calm] + futs:
+            f.result(timeout=60)
+    finally:
+        cat.close()
+
+
+# -- accounting: co-stacked batches charge the originating tenant --------
+
+
+def test_mixed_batch_attribution_per_tenant(tmp_path):
+    """A co-stacked mixed batch charges rows/requests/latency to each
+    ORIGINATING tenant's labeled series, and the group's own compile /
+    tenants-per-group series exist."""
+    pubs = {mid: _publish(tmp_path, mid, seed)
+            for mid, seed in (("x", 103), ("y", 104))}
+    cat = ModelCatalog({mid: p for mid, (p, _b, _x) in pubs.items()},
+                       params={"verbose": -1}, serve_quantize="raw")
+    try:
+        (gid,) = cat._groups
+        rows0 = {mid: profiling.counter_value(
+            profiling.labeled("serve.rows", model=mid)) for mid in pubs}
+        _mixed_round(cat, {"x": pubs["x"][2][:5], "y": pubs["y"][2][:9]})
+        assert profiling.counter_value(profiling.labeled(
+            "serve.rows", model="x")) == rows0["x"] + 5
+        assert profiling.counter_value(profiling.labeled(
+            "serve.rows", model="y")) == rows0["y"] + 9
+        for mid in pubs:
+            assert profiling.summary(profiling.labeled(
+                "serve.latency_ms", model=mid))["count"] >= 1
+        # group series: compiles happened at construction, gauges name
+        # the group and its tenant count
+        assert profiling.counter_value(profiling.labeled(
+            profiling.SERVE_GROUP_COMPILES, group=gid)) > 0
+        gauges = cat.gauges()
+        assert gauges["serve.groups"] == 1
+        assert gauges[profiling.labeled("serve.group_tenants",
+                                        group=gid)] == 2
+        # stats surfaces group membership on tenants and a groups block
+        st = cat.tenant_stats()
+        assert st["x"]["group"] == gid
+        gs = cat.group_stats()
+        assert gs[gid]["tenants"] == 2
+        assert sorted(gs[gid]["members"]) == ["x", "y"]
+    finally:
+        cat.close()
+
+
+def test_costack_key_fn(tmp_path):
+    """costack_key exposes the grouping triple (K, variant, leaf tier)
+    the policy docs promise."""
+    _p, bst, _X = _publish(tmp_path, "k", 105)
+    key = costack_key(_solo(bst))
+    assert key[0] == 1 and key[1] == "raw"
+    assert key[2] & (key[2] - 1) == 0           # power of two
+
+
+def test_http_server_demuxes_costacked_tenants(tmp_path):
+    """End to end through the HTTP server: concurrent requests naming
+    different co-stacked tenants each answer with THEIR model (bitwise
+    vs solo dispatch), /stats carries the groups block, and /healthz
+    reports the group count the router's health sweep reads."""
+    import http.client
+    import json
+    from lightgbm_tpu.serving import PredictionServer
+
+    pubs = {mid: _publish(tmp_path, mid, seed)
+            for mid, seed in (("left", 111), ("right", 112))}
+    cat = ModelCatalog({mid: p for mid, (p, _b, _x) in pubs.items()},
+                       params={"verbose": -1}, serve_quantize="raw")
+    srv = PredictionServer(catalog=cat, model_poll_seconds=0)
+    want = {mid: _solo(bst).predict(pubs[mid][2][:8])
+            for mid, (_p, bst, _X) in pubs.items()}
+
+    def _req(method, path, body=None):
+        conn = http.client.HTTPConnection(srv.host, srv.port, timeout=60)
+        try:
+            conn.request(method, path, body)
+            r = conn.getresponse()
+            return r.status, r.read().decode()
+        finally:
+            conn.close()
+
+    with srv:
+        errs = []
+
+        def client(mid):
+            try:
+                body = json.dumps(
+                    {"rows": [[float(v) for v in row]
+                              for row in pubs[mid][2][:8]],
+                     "model": mid})
+                status, text = _req("POST", "/predict", body)
+                assert status == 200, text
+                got = np.array([json.loads(l)
+                                for l in text.strip().splitlines()])
+                assert np.array_equal(got, want[mid]), mid
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errs.append(e)
+
+        threads = [threading.Thread(target=client, args=(mid,))
+                   for mid in pubs for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errs, errs
+        status, text = _req("GET", "/stats")
+        assert status == 200
+        stats = json.loads(text)
+        (gid,) = stats["groups"]
+        assert sorted(stats["groups"][gid]["members"]) == ["left", "right"]
+        assert stats["models"]["left"]["group"] == gid
+        status, text = _req("GET", "/healthz")
+        assert status == 200
+        assert json.loads(text)["groups"] == 1
+
+
+def test_group_runtime_rejects_plain_predict(tmp_path):
+    """GroupRuntime refuses the solo predict() entry — mixed batches
+    must carry tenant ids, so the batcher routes predict_mixed."""
+    pubs = {mid: _publish(tmp_path, mid, seed)
+            for mid, seed in (("a", 106), ("b", 107))}
+    cat = ModelCatalog({mid: p for mid, (p, _b, _x) in pubs.items()},
+                       params={"verbose": -1}, serve_quantize="raw")
+    try:
+        (group,) = cat._groups.values()
+        assert isinstance(group.runtime, GroupRuntime)
+        with pytest.raises(LightGBMError):
+            group.runtime.predict(pubs["a"][2][:4])
+    finally:
+        cat.close()
