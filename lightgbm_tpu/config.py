@@ -19,6 +19,16 @@ from typing import Any, Dict, List, Optional, Tuple
 # check against ONE tuple and can't drift
 PREDICT_KERNELS = ("auto", "tensorized", "walk")
 
+# the costack_kernel dial's legal values — grouped-traversal strategy
+# of cross-model co-stacked serving (docs/serving.md "Cross-model
+# batching"): "stacked" walks every stacked tree for every row (free
+# where launch overhead dominates), "segment" gathers only the row's
+# own tenant's tree segment per depth level (node math ~1x a solo
+# tenant's on compute-bound tiers), "auto" resolves per backend
+# (ops/predict.resolve_costack_kernel).  Both are bitwise-identical
+# to per-tenant dispatch.
+COSTACK_KERNELS = ("auto", "stacked", "segment")
+
 # the serve_quantize dial's legal values — request-path feature
 # quantization (docs/serving.md "Binned inference"): "binned" serves
 # integer bins end-to-end against the model's .refbin frozen-mapper
@@ -329,6 +339,9 @@ PARAM_ALIASES: Dict[str, str] = {
     "canary_max_divergence": "serve_shadow_max_divergence",
     "costack": "serve_costack",
     "cross_model_batching": "serve_costack",
+    "serve_costack_kernel": "costack_kernel",
+    "cross_model_kernel": "costack_kernel",
+    "group_kernel": "costack_kernel",
     # router tier (task=route, lightgbm_tpu/router/, docs/Router.md)
     "router_backends": "route_backends",
     "backends": "route_backends",
@@ -697,9 +710,17 @@ class Config:
     # batch of many tenants costs one device launch, bitwise-identical
     # to per-tenant dispatch.  Off = every tenant keeps its own
     # executables (the PR 15 layout).  Tenants opt out individually
-    # with a `;costack=off` entry override, and a per-tenant
-    # `;replicas=` override also forces that tenant solo.
+    # with a `;costack=off` entry override; a group's replica fleet
+    # sizes to the MAX of its members' `;replicas=` overrides.
     serve_costack: bool = True
+    # grouped-traversal strategy for co-stacked executables
+    # (COSTACK_KERNELS): "stacked" walks all T_total stacked trees per
+    # row, "segment" gathers only the row's own tenant's tree segment
+    # per depth level — same ONE launch per (bucket, kind), node math
+    # back to ~1x.  "auto" picks segment on compute-bound backends
+    # (CPU, or very deep stacks on accelerators) and stacked where
+    # launch overhead dominates (ops/predict.resolve_costack_kernel).
+    costack_kernel: str = "auto"
     # shadow-canary publishes: with a fraction > 0, a republished model
     # is STAGED as a candidate instead of swapped live — this fraction
     # of requests is double-scored on it (stable still answers the
@@ -735,6 +756,15 @@ class Config:
     # load with HTTP 503 + Retry-After instead of stacking threads on
     # slow backends.  0 = unbounded.
     route_max_inflight: int = 0
+    # co-stack-aware placement spread: tenants whose backends report a
+    # co-stack group key (serving /healthz "group_keys") hash to
+    # backends BY THAT KEY, so same-key tenants land on one backend and
+    # actually group.  Values > 1 salt the key with the tenant id into
+    # this many shards — a very large same-key cohort spreads over up
+    # to `route_group_spread` backends (each shard's tenants still
+    # co-locate and group).  1 = strict co-location (the
+    # grouping-maximizing default).
+    route_group_spread: int = 1
 
     # -- fault tolerance (task=train checkpoint/resume, docs/Robustness.md)
     # when set, training snapshots (model + iteration + early-stopping +
@@ -927,6 +957,9 @@ def check_param_conflict(cfg: Config) -> None:
     if cfg.serve_quantize not in SERVE_QUANTIZE_MODES:
         raise ValueError(f"unknown serve_quantize: {cfg.serve_quantize}; "
                          f"use one of {SERVE_QUANTIZE_MODES}")
+    if cfg.costack_kernel not in COSTACK_KERNELS:
+        raise ValueError(f"unknown costack_kernel: {cfg.costack_kernel}; "
+                         f"use one of {COSTACK_KERNELS}")
     if cfg.serve_models:
         parse_serve_models(cfg.serve_models)   # id=path shape + id charset
     if cfg.serve_cache_budget_mb < 0:
@@ -947,6 +980,9 @@ def check_param_conflict(cfg: Config) -> None:
         raise ValueError("route_backend_timeout_ms must be > 0")
     if cfg.route_max_inflight < 0:
         raise ValueError("route_max_inflight must be >= 0 (0 = unbounded)")
+    if cfg.route_group_spread < 1:
+        raise ValueError("route_group_spread must be >= 1 (1 = strict "
+                         "same-key co-location)")
     if not (0.0 <= cfg.refit_decay_rate <= 1.0):
         raise ValueError("refit_decay_rate must be in [0, 1]")
     if cfg.refit_min_rows < 0:

@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..config import PREDICT_KERNELS
+from ..config import COSTACK_KERNELS, PREDICT_KERNELS
 
 
 def resolve_predict_kernel(kernel: str = "auto") -> str:
@@ -54,6 +54,38 @@ def resolve_predict_kernel(kernel: str = "auto") -> str:
         raise ValueError(f"unknown predict_kernel: {kernel!r}; "
                          f"use one of {PREDICT_KERNELS}")
     return "tensorized" if kernel == "auto" else kernel
+
+
+# above this total stacked tree count, even launch-bound accelerators
+# go compute-bound on the walk-all grouped traversal: the per-level
+# record gather over all T_total trees dwarfs the one launch that
+# co-stacking saves, so `auto` switches to the segment-gathered walk.
+COSTACK_SEGMENT_TREES = 4096
+
+
+def resolve_costack_kernel(kernel: str = "auto", *,
+                           total_trees: int = 0) -> str:
+    """Resolve the ``costack_kernel`` dial to a concrete grouped
+    traversal (config.COSTACK_KERNELS).
+
+    ``auto`` picks ``segment`` on compute-bound backends (CPU: node
+    math scales with the trees walked, so walking all T_total stacked
+    trees costs ~G x a solo tenant per row) and on accelerators once
+    the group's total stacked tree count crosses
+    ``COSTACK_SEGMENT_TREES``; ``stacked`` stays the pick where launch
+    overhead dominates (the TPU premise — surplus trees ride a
+    gather-bound depth loop for free).  Both variants are
+    bitwise-identical to per-tenant dispatch (tests/test_costack.py),
+    so the dial is purely a cost model.
+    """
+    if kernel not in COSTACK_KERNELS:
+        raise ValueError(f"unknown costack_kernel: {kernel!r}; "
+                         f"use one of {COSTACK_KERNELS}")
+    if kernel != "auto":
+        return kernel
+    if jax.default_backend() not in ("tpu", "gpu"):
+        return "segment"
+    return "segment" if total_trees >= COSTACK_SEGMENT_TREES else "stacked"
 
 
 class TreeStack(NamedTuple):
@@ -473,6 +505,34 @@ def _leaf_sums(stack: EnsembleStack, node: jax.Array, num_class: int
                                indices_are_sorted=True)
 
 
+def _raw_decide(rec: jax.Array, v: jax.Array, any_cat: bool) -> jax.Array:
+    """Go-left mask from packed raw node records and gathered feature
+    values — THE numerical/categorical routing decision, shared by the
+    full-stack walk (`_walk_raw_nodes`) and the segment-gathered walk
+    (`_walk_raw_segment`) so the two can never disagree: numerical
+    ``v <= t`` (NaN falls right), categorical int-truncation compare
+    behind a finite mask."""
+    t = rec[..., 1]
+    gl = v <= t
+    if any_cat:
+        finite = jnp.isfinite(v)
+        vi = jnp.where(finite, v, -1.0).astype(jnp.int32)
+        gl = jnp.where(rec[..., 2] > 0,
+                       finite & (vi == t.astype(jnp.int32)), gl)
+    return gl
+
+
+def _binned_decide(rec: jax.Array, bv: jax.Array,
+                   any_cat: bool) -> jax.Array:
+    """Go-left mask from packed BINNED node records and gathered bin
+    ids — integer compares end to end, shared by `_walk_binned_nodes`
+    and `_walk_binned_segment` (same contract as `_raw_decide`)."""
+    t = rec[..., 1].astype(jnp.int32)
+    if any_cat:
+        return jnp.where(rec[..., 2] == 1, bv == t, bv <= t)
+    return bv <= t
+
+
 def _walk_raw_nodes(stack: EnsembleStack, Xf: jax.Array, meta
                     ) -> jax.Array:
     """The raw-feature ensemble walk itself: parked node per (tree, row)
@@ -495,13 +555,7 @@ def _walk_raw_nodes(stack: EnsembleStack, Xf: jax.Array, meta
         rec = jnp.take_along_axis(stack.nodes, safe[:, :, None], axis=1)
         f = rec[..., 0].astype(jnp.int32)
         v = Xf[rows, f]                                  # [T, N]
-        t = rec[..., 1]
-        gl = v <= t
-        if meta.any_cat:
-            finite = jnp.isfinite(v)
-            vi = jnp.where(finite, v, -1.0).astype(jnp.int32)
-            gl = jnp.where(rec[..., 2] > 0,
-                           finite & (vi == t.astype(jnp.int32)), gl)
+        gl = _raw_decide(rec, v, meta.any_cat)
         nxt = jnp.where(gl, rec[..., 3], rec[..., 4]).astype(jnp.int32)
         return jnp.where(node >= 0, nxt, node)
 
@@ -601,7 +655,6 @@ def _walk_binned_nodes(stack: EnsembleStack, bins_nt: jax.Array,
         safe = jnp.maximum(node, 0)
         rec = jnp.take_along_axis(stack.nodes, safe[:, :, None], axis=1)
         f = rec[..., 0].astype(jnp.int32)
-        t = rec[..., 1].astype(jnp.int32)
         if ft is None:
             bv = bins_nt[rows, f]
         else:
@@ -615,10 +668,7 @@ def _walk_binned_nodes(stack: EnsembleStack, bins_nt: jax.Array,
             in_r = (s >= 0) & (s < ns)
             orig = jnp.where(in_r, s + (s >= dflt).astype(jnp.int32), dflt)
             bv = jnp.where(pk, orig, bv_store)
-        if meta.any_cat:
-            gl = jnp.where(rec[..., 2] == 1, bv == t, bv <= t)
-        else:
-            gl = bv <= t
+        gl = _binned_decide(rec, bv, meta.any_cat)
         nxt = jnp.where(gl, rec[..., 3], rec[..., 4]).astype(jnp.int32)
         return jnp.where(node >= 0, nxt, node)
 
@@ -780,3 +830,149 @@ def predict_ensemble_grouped_binned(stack: EnsembleStack, Xb: jax.Array,
     """
     node = _walk_binned_nodes(stack, Xb, None, meta)
     return _grouped_sums(stack, node, tids, meta)
+
+
+# ----------------------------------------------------------------------
+# segment-gathered grouped traversal (costack_kernel=segment) — each
+# row walks ONLY its own tenant's tree segment.  The walk-all kernels
+# above are gather-bound where launch overhead dominates (the TPU
+# premise), but cost ~G x a solo tenant's node math per row on
+# compute-bound tiers; here per-depth-level record/feature gathers
+# index ``seg_start[tid] + local_tree`` over L = max segment length
+# slots, so node math returns to ~1x while the group still compiles
+# ONE executable per (bucket, kind).
+# ----------------------------------------------------------------------
+
+def _segment_slots(stack: EnsembleStack, tids: jax.Array,
+                   meta: GroupMeta) -> tuple:
+    """Per-(slot, row) tree indices for the segment-gathered walk:
+    ``tree[j, n] = seg_start[tids[n]] + j`` over L = max segment
+    length slots, plus the ``valid`` mask (``j < len(segment)``).
+    ``meta.segments`` is static, so the offset tables are trace-time
+    constants; slots past a short tenant's segment clamp to a real
+    tree (walked and discarded — `_segment_sums` zeroes them), and an
+    out-of-range tid clamps exactly like `_grouped_sums`' final
+    gather."""
+    starts = np.fromiter((a for a, _b in meta.segments), np.int32,
+                         len(meta.segments))
+    stops = np.fromiter((b for _a, b in meta.segments), np.int32,
+                        len(meta.segments))
+    L = int((stops - starts).max())
+    T = stack.nodes.shape[0]
+    tids = tids.astype(jnp.int32)
+    start = jnp.asarray(starts)[tids]                      # [N]
+    length = jnp.asarray(stops - starts)[tids]             # [N]
+    j = jnp.arange(L, dtype=jnp.int32)[:, None]            # [L, 1]
+    valid = j < length[None, :]                            # [L, N]
+    tree = jnp.minimum(start[None, :] + j, T - 1)          # [L, N]
+    return tree, valid
+
+
+def _walk_raw_segment(stack: EnsembleStack, Xf: jax.Array,
+                      tree: jax.Array, meta: GroupMeta) -> jax.Array:
+    """Raw-feature walk over per-row gathered tree slots: parked node
+    per (slot, row) — [L, N] int32, leaves as ~leaf.  Identical
+    per-level structure to `_walk_raw_nodes` (one record gather, one
+    feature gather, one select) with the tree axis indexed per row
+    instead of broadcast; routing decisions go through the SAME
+    `_raw_decide`, so a row's own trees park on exactly the leaves the
+    walk-all kernel parks them on."""
+    rows = jnp.arange(Xf.shape[0])[None, :]
+
+    def step(_, node):
+        safe = jnp.maximum(node, 0)
+        rec = stack.nodes[tree, safe]                      # [L, N, lanes]
+        f = rec[..., 0].astype(jnp.int32)
+        v = Xf[rows, f]                                    # [L, N]
+        gl = _raw_decide(rec, v, meta.any_cat)
+        nxt = jnp.where(gl, rec[..., 3], rec[..., 4]).astype(jnp.int32)
+        return jnp.where(node >= 0, nxt, node)
+
+    return jax.lax.fori_loop(0, meta.depth, step, stack.root[tree])
+
+
+def _walk_binned_segment(stack: EnsembleStack, bins_nt: jax.Array,
+                         tree: jax.Array, meta: GroupMeta) -> jax.Array:
+    """Binned walk over per-row gathered tree slots — `_walk_raw_segment`
+    with integer compares through the shared `_binned_decide` (the
+    serving request path under serve_quantize=binned; no ``feat_tbl``:
+    request buffers speak original (feature, bin) space)."""
+    bins_nt = bins_nt.astype(jnp.int32)
+    rows = jnp.arange(bins_nt.shape[0])[None, :]
+
+    def step(_, node):
+        safe = jnp.maximum(node, 0)
+        rec = stack.nodes[tree, safe]                      # [L, N, lanes]
+        f = rec[..., 0].astype(jnp.int32)
+        bv = bins_nt[rows, f]                              # [L, N]
+        gl = _binned_decide(rec, bv, meta.any_cat)
+        nxt = jnp.where(gl, rec[..., 3], rec[..., 4]).astype(jnp.int32)
+        return jnp.where(node >= 0, nxt, node)
+
+    return jax.lax.fori_loop(0, meta.depth, step, stack.root[tree])
+
+
+def _segment_sums(stack: EnsembleStack, node: jax.Array, tree: jax.Array,
+                  valid: jax.Array, meta: GroupMeta) -> jax.Array:
+    """[K, N] per-class sums of the [L, N] segment walk's parked leaf
+    values — the demux of the segment kernels.
+
+    Row n's slots hold ITS tenant's trees in stack order (class-major —
+    exactly the solo stack order), padded slots gather a clamped tree
+    and mask to an exact +0.0 addend.  The reduction therefore adds the
+    same fp32 dyadic leaf values in the same order as the solo
+    reduction (`_leaf_sums`) with exact-zero padding interleaved —
+    exact for the dyadic leaf-value domain every grouped/solo parity
+    in this module already stands on, and pinned bitwise against both
+    `_grouped_sums` and per-tenant dispatch in tests/test_costack.py.
+    K>1 demuxes by each slot's gathered class id (sorted within a
+    segment, so each class's trees still add in stack order) with a
+    sequential in-slot-order accumulation: `jax.ops.segment_sum` — the
+    solo/`_grouped_sums` K>1 reduction — adds segment members
+    sequentially in index order, and a masked `jnp.sum` over the slot
+    axis reassociates (pairwise) and lands ~1 ulp off, so the loop is
+    what keeps the multiclass demux bitwise."""
+    leaf = jnp.where(node < 0, ~node, 0)
+    vals = jnp.where(valid, stack.leaf_value[tree, leaf],
+                     jnp.float32(0.0))                     # [L, N]
+    if meta.num_class == 1:
+        return jnp.sum(vals, axis=0)[None]
+    cls = stack.class_id[tree]                             # [L, N]
+    ks = jnp.arange(meta.num_class, dtype=cls.dtype)[:, None]
+
+    def step(j, acc):
+        return acc + jnp.where(cls[j][None, :] == ks, vals[j][None, :],
+                               jnp.float32(0.0))
+
+    return jax.lax.fori_loop(0, vals.shape[0], step,
+                             jnp.zeros((meta.num_class, node.shape[1]),
+                                       jnp.float32))
+
+
+@functools.partial(jax.jit, static_argnames=("meta",))
+def predict_ensemble_grouped_segment(stack: EnsembleStack, X: jax.Array,
+                                     tids: jax.Array, *,
+                                     meta: GroupMeta) -> jax.Array:
+    """Mixed-tenant raw scores over raw features, segment-gathered —
+    [K, N] f32, bitwise-identical to `predict_ensemble_grouped` and to
+    per-tenant dispatch.  Row n walks the L = max-segment-length tree
+    slots of its own tenant instead of all T_total stacked trees: same
+    ONE launch per (bucket, kind), per-row node math back to ~1x."""
+    tree, valid = _segment_slots(stack, tids, meta)
+    node = _walk_raw_segment(stack, X.astype(jnp.float32), tree, meta)
+    return _segment_sums(stack, node, tree, valid, meta)
+
+
+@functools.partial(jax.jit, static_argnames=("meta",))
+def predict_ensemble_grouped_segment_binned(stack: EnsembleStack,
+                                            Xb: jax.Array,
+                                            tids: jax.Array, *,
+                                            meta: GroupMeta) -> jax.Array:
+    """Mixed-tenant raw scores over ingress-quantized bin ids,
+    segment-gathered — the binned twin of
+    `predict_ensemble_grouped_segment` (integer compares end to end;
+    buffers padded to the group-wide max feature count exactly like
+    `predict_ensemble_grouped_binned`)."""
+    tree, valid = _segment_slots(stack, tids, meta)
+    node = _walk_binned_segment(stack, Xb, tree, meta)
+    return _segment_sums(stack, node, tree, valid, meta)

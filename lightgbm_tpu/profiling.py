@@ -137,8 +137,21 @@ SERVE_SHADOW_REJECTIONS = "serve/shadow_rejections"
 #  - SERVE_GROUP_RESTACKS: super-stack rebuilds after a member tenant's
 #    hot swap (cache-transplanting restacks included; only restacks
 #    whose program changed also show up as group compiles).
+#  - SERVE_GROUP_SEGMENT_ROWS / SERVE_GROUP_STACKED_ROWS: mixed-batch
+#    rows demuxed through a group executable, split by the RESOLVED
+#    costack kernel — segment (per-row tree-segment gather: node math
+#    ~1x a solo tenant's) vs stacked (walk-all: ~G x node math where
+#    launch overhead hides it).  The per-group labeled series ride the
+#    same names; summed they equal the grouped share of serve.rows.
+#  - SERVE_GROUP_QUANTIZE_SHARED: rows a binned group quantized ONCE
+#    against its members' shared refbin mapper set at ingress instead
+#    of once per member job — the host-CPU dedup of the shared ingress
+#    quantizer (rows also counted in SERVE_QUANTIZE_BYTES_IN by bytes).
 SERVE_GROUP_COMPILES = "serve/group_compiles"
 SERVE_GROUP_RESTACKS = "serve/group_restacks"
+SERVE_GROUP_SEGMENT_ROWS = "serve/group_segment_rows"
+SERVE_GROUP_STACKED_ROWS = "serve/group_stacked_rows"
+SERVE_GROUP_QUANTIZE_SHARED = "serve/group_quantize_shared"
 
 # Canonical router-tier counters (docs/Router.md), fed through count()
 # by the task=route process fronting M backend serving processes:
@@ -178,6 +191,8 @@ CANONICAL_COUNTERS = (
     SERVE_QUANTIZE_BYTES_IN, SERVE_BINNED_REQUESTS,
     SERVE_CACHE_EVICTIONS, SERVE_SHADOW_SCORED, SERVE_SHADOW_ADOPTIONS,
     SERVE_SHADOW_REJECTIONS, SERVE_GROUP_COMPILES, SERVE_GROUP_RESTACKS,
+    SERVE_GROUP_SEGMENT_ROWS, SERVE_GROUP_STACKED_ROWS,
+    SERVE_GROUP_QUANTIZE_SHARED,
     ROUTER_REQUESTS, ROUTER_RETRIES, ROUTER_REJECTED,
     ROUTER_BACKEND_FAILURES, ROUTER_BACKEND_BROKEN,
     ROUTER_BACKEND_READMITTED, ROUTER_BACKEND_PROBES, ROUTER_REHASHES,

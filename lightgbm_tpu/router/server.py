@@ -60,7 +60,7 @@ from ..httpd import SeveringHTTPServer
 from ..config import MODEL_ID_RE, Config, parse_route_backends
 from ..diagnostics import faults
 from ..log import LightGBMError
-from .placement import HashRing
+from .placement import HashRing, _point
 
 # same charset as serving/server.py's ingress validation — duplicated
 # (not imported) so the router never pulls the numpy/jax serving stack
@@ -393,7 +393,8 @@ class RouterServer:
                  *, host: str = "127.0.0.1", port: int = 0,
                  health_interval_ms: float = 1000.0,
                  backend_timeout_ms: float = 30000.0,
-                 max_inflight: int = 0, failure_threshold: int = 3):
+                 max_inflight: int = 0, failure_threshold: int = 3,
+                 group_spread: int = 1):
         if not backends:
             raise LightGBMError(
                 "the router needs at least one backend: set "
@@ -404,7 +405,11 @@ class RouterServer:
         self.backend_timeout_s = max(float(backend_timeout_ms), 1.0) / 1e3
         self.max_inflight = int(max_inflight)
         self.failure_threshold = max(int(failure_threshold), 1)
+        self.group_spread = max(int(group_spread), 1)
         self._lock = threading.Lock()
+        # model id -> co-stack group key, merged from the backends'
+        # /healthz "group_keys" payloads (see _placement_key)
+        self._group_keys: Dict[str, str] = {}
         self._backends: Dict[str, BackendState] = {
             addr: BackendState(i, addr)
             for i, addr in enumerate(self.ring.backends)}
@@ -425,14 +430,31 @@ class RouterServer:
         with self._lock:
             return sum(1 for b in self._backends.values() if not b.broken)
 
+    def _placement_key(self, model_id: Optional[str]) -> str:
+        """The key a tenant hashes the ring with: its co-stack group
+        key when the health sweeps have reported one (so compatible
+        tenants land on the SAME backend and actually co-stack there),
+        the model id otherwise.  group_spread > 1 salts the group key
+        with the tenant's own hash point modulo the spread, trading
+        strict co-location for load spread across that many cohorts —
+        tenants in the same cohort still co-stack."""
+        key = model_id or "default"
+        gk = self._group_keys.get(key)
+        if gk is None:
+            return key
+        if self.group_spread > 1:
+            return f"{gk}#{_point(key) % self.group_spread}"
+        return gk
+
     def _place_home(self, model_id: Optional[str]) -> str:
         """The tenant's home backend over the FULL fleet (overrides
-        first, ring otherwise) — liveness is applied by _pick, so a
-        drained tenant returns home on readmission."""
+        first, ring over the placement key otherwise) — liveness is
+        applied by _pick, so a drained tenant returns home on
+        readmission."""
         key = model_id or "default"
         home = self.overrides.get(key)
         if home is None:
-            home = self.ring.place(key)
+            home = self.ring.place(self._placement_key(key))
         return home
 
     def _pick(self, model_id: Optional[str], exclude: Optional[str] = None,
@@ -465,7 +487,12 @@ class RouterServer:
                 if chosen is None:
                     alive = [b.addr for b in self._backends.values()
                              if not b.broken and b.addr != exclude]
-                    replaced = self.ring.place(key, alive)
+                    # re-place by the PLACEMENT key: every tenant of a
+                    # drained group re-hashes to the same survivor, so
+                    # the group re-forms (one compile) instead of
+                    # scattering into G solo tenants
+                    replaced = self.ring.place(self._placement_key(key),
+                                               alive)
                     if replaced is not None:
                         if home.broken:
                             profiling.count(profiling.ROUTER_REHASHES)
@@ -647,6 +674,14 @@ class RouterServer:
                 continue
             with self._lock:
                 b.last_health = health
+                # merge, don't replace: each backend only knows ITS
+                # tenants' group keys; the union is the fleet map that
+                # steers placement (stale keys for unpublished tenants
+                # are harmless — they just keep steering consistently)
+                gk = health.get("group_keys")
+                if isinstance(gk, dict):
+                    self._group_keys.update(
+                        {str(m): str(k) for m, k in gk.items()})
             self._note_success(b, dispatched=False)
 
     def _health_loop(self) -> None:
@@ -730,6 +765,11 @@ class RouterServer:
             "groups": {addr: (snap["health"] or {}).get("groups")
                        for addr, snap in backs.items()
                        if snap["health"] is not None},
+            # the co-stack placement map the ring hashes with (merged
+            # from the health sweeps) and its spread knob — the fleet
+            # view of WHY same-group tenants share a home backend
+            "group_keys": dict(self._group_keys),
+            "group_spread": self.group_spread,
             "overrides": dict(self.overrides),
             "inflight": self._inflight,
             "max_inflight": self.max_inflight,
@@ -822,7 +862,8 @@ def router_from_config(cfg: Config) -> RouterServer:
         health_interval_ms=cfg.route_health_interval_ms,
         backend_timeout_ms=cfg.route_backend_timeout_ms,
         max_inflight=cfg.route_max_inflight,
-        failure_threshold=cfg.replica_failure_threshold)
+        failure_threshold=cfg.replica_failure_threshold,
+        group_spread=cfg.route_group_spread)
 
 
 def route_from_config(cfg: Config) -> None:

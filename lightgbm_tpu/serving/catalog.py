@@ -151,7 +151,9 @@ class ModelCatalog:
     ``replicas``, ``serve_quantize``, ``max_pending_rows``, or
     ``costack`` (docs/serving.md "Cross-model batching");
     ``max_pending_rows`` always applies PER TENANT (it is an admission
-    budget, so a hot tenant sheds its own load).
+    budget, so a hot tenant sheds its own load), and a co-stack group's
+    replica fleet sizes to the MAX of its members' ``replicas``
+    overrides (`_group_replicas`).
     """
 
     def __init__(self, models: Dict[str, object],
@@ -169,7 +171,8 @@ class ModelCatalog:
                  shadow_requests: int = 32,
                  shadow_max_divergence: float = -1.0,
                  warmup_buckets=(1,),
-                 costack: bool = True):
+                 costack: bool = True,
+                 costack_kernel: str = "auto"):
         if not models:
             raise LightGBMError("ModelCatalog needs at least one "
                                 "model id=path entry")
@@ -193,15 +196,18 @@ class ModelCatalog:
         self._max_pending_rows = max_pending_rows
         self._warmup_buckets = tuple(warmup_buckets)
         self._costack = bool(costack)
+        self._costack_kernel = str(costack_kernel)
         solo_forced: Dict[str, bool] = {}
         caps: Dict[str, int] = {}
         for mid, (path, ov) in entries.items():
-            # per-tenant overrides: replicas forces SOLO (a group's
-            # replica fleet is shared, so a tenant dialing its own
-            # footprint cannot ride one), costack=off opts out
+            # per-tenant overrides: costack=off opts out of grouping; a
+            # replicas override rides its group (the group fleet sizes
+            # to the members' max — _group_replicas) AND sizes the
+            # tenant's solo runtime for fallback
             t_replicas = int(ov.get("replicas", replicas))
-            solo_forced[mid] = ("replicas" in ov
-                               or not ov.get("costack", True))
+            if "replicas" in ov:
+                self._replica_ov[mid] = t_replicas
+            solo_forced[mid] = not ov.get("costack", True)
             caps[mid] = int(ov.get("max_pending_rows", max_pending_rows))
             registry = ModelRegistry(
                 path, params=params, num_iteration=num_iteration,
@@ -256,6 +262,10 @@ class ModelCatalog:
         self._miss_mark = -1                 # submit-path dirty check
         self._tenants: Dict[str, _Tenant] = {}
         self._groups: Dict[str, _Group] = {}
+        self._costack = False                # overridden by __init__;
+        self._costack_kernel = "auto"        # shim defaults otherwise
+        self._costack_opt_out: set = set()
+        self._replica_ov: Dict[str, int] = {}
 
     # -- co-stack grouping ----------------------------------------------
 
@@ -264,6 +274,8 @@ class ModelCatalog:
         (superstack.costack_key); singletons and opted-out tenants stay
         solo.  Runs once at construction — membership is stable until a
         member republish breaks compatibility (_restack drops it)."""
+        self._costack_opt_out = {mid for mid, forced in solo_forced.items()
+                                 if forced}
         if not self._costack:
             return
         by_key: Dict[tuple, List[str]] = {}
@@ -282,6 +294,15 @@ class ModelCatalog:
                     break                    # a trailing singleton: solo
                 self._build_group(key, members, chunk_no)
 
+    def _group_replicas(self, member_ids: List[str]) -> int:
+        """A group's replica fleet size: the MAX of its members'
+        per-tenant ``replicas`` overrides (the hottest member sizes the
+        shared fleet — every member rides it), the fleet-wide
+        ``serve_replicas`` when no member overrides."""
+        ov = [self._replica_ov[mid] for mid in member_ids
+              if mid in self._replica_ov]
+        return max(ov) if ov else self._replicas
+
     def _build_group(self, key, member_ids: List[str],
                      chunk_no: int = 0) -> None:
         gid = group_id_for(key, chunk_no)
@@ -290,8 +311,9 @@ class ModelCatalog:
         runtime = GroupRuntime(
             member_ids,
             [registries[mid].current() for mid in member_ids],
-            group_id=gid, replicas=self._replicas,
-            failure_threshold=self._failure_threshold)
+            group_id=gid, replicas=self._group_replicas(member_ids),
+            failure_threshold=self._failure_threshold,
+            costack_kernel=self._costack_kernel)
         runtime.warmup(self._warmup_buckets, OUTPUT_KINDS)
         group = _Group(gid, key, member_ids, registries, runtime)
         group.batcher = MicroBatcher(
@@ -537,8 +559,9 @@ class ModelCatalog:
         runtime = GroupRuntime(
             stay, [group.registries[mid].current() for mid in stay],
             group_id=group.group_id, generation=old.generation + 1,
-            replicas=self._replicas,
-            failure_threshold=self._failure_threshold)
+            replicas=self._group_replicas(stay),
+            failure_threshold=self._failure_threshold,
+            costack_kernel=self._costack_kernel)
         if not runtime.adopt_cache_from(old):
             # program changed (tree shapes, transforms, membership):
             # warm every bucket/kind the outgoing group served before
@@ -630,6 +653,23 @@ class ModelCatalog:
             }
         return out
 
+    def group_keys(self) -> Dict[str, str]:
+        """Per-tenant co-stack compatibility key (the group-id base
+        string) for every tenant that may group — the payload serving
+        /healthz hands the router tier so its placement can co-locate
+        same-key tenants onto one backend (co-stack-aware placement,
+        docs/Router.md).  Tenants that opted out (``costack=off``) are
+        omitted — they place by tenant id as before — as is everything
+        when fleet-wide co-stacking is off."""
+        out: Dict[str, str] = {}
+        if not self._costack:
+            return out
+        for mid, t in self._tenants.items():
+            if mid in self._costack_opt_out:
+                continue
+            out[mid] = group_id_for(costack_key(t.registry.current()))
+        return out
+
     def group_stats(self) -> Dict[str, dict]:
         """The /stats ``groups`` block: per-group co-stack view."""
         out: Dict[str, dict] = {}
@@ -646,6 +686,15 @@ class ModelCatalog:
                 "depth": rt._gmeta.depth,
                 "num_class": rt.K,
                 "variant": rt.variant,
+                "costack_kernel": rt.costack_kernel,
+                "segment_rows": profiling.counter_value(profiling.labeled(
+                    profiling.SERVE_GROUP_SEGMENT_ROWS, group=gid)),
+                "stacked_rows": profiling.counter_value(profiling.labeled(
+                    profiling.SERVE_GROUP_STACKED_ROWS, group=gid)),
+                "quantize_shared_rows": profiling.counter_value(
+                    profiling.labeled(
+                        profiling.SERVE_GROUP_QUANTIZE_SHARED, group=gid)),
+                "shared_quantizer": rt._shared_quantizer is not None,
                 "cache_bytes": group.cache_bytes(),
                 "queue_depth": (group.batcher.queue_depth
                                 if group.batcher is not None else 0),

@@ -154,7 +154,12 @@ class _Handler(BaseHTTPRequestHandler):
                 "stale": stale,
                 # co-stack group count: the router's health sweep
                 # surfaces per-backend executable-sharing at /stats
-                "groups": len(srv.catalog._groups)})
+                "groups": len(srv.catalog._groups),
+                # per-tenant co-stack compatibility keys: the router's
+                # co-stack-aware placement hashes THESE (not tenant
+                # ids) so same-key tenants land on one backend and
+                # actually group (docs/Router.md)
+                "group_keys": srv.catalog.group_keys()})
         elif path == "/stats":
             self._respond_json(200, srv.stats())
         elif path == "/metrics":
@@ -542,7 +547,8 @@ def server_from_config(cfg: Config) -> PredictionServer:
         shadow_fraction=cfg.serve_shadow_fraction,
         shadow_requests=cfg.serve_shadow_requests,
         shadow_max_divergence=cfg.serve_shadow_max_divergence,
-        costack=cfg.serve_costack)
+        costack=cfg.serve_costack,
+        costack_kernel=cfg.costack_kernel)
     return PredictionServer(
         catalog=catalog, host=cfg.serve_host, port=cfg.serve_port,
         model_poll_seconds=cfg.model_poll_seconds,

@@ -12,10 +12,16 @@ trees across MODELS:
 
 - `GroupRuntime` concatenates compatible tenants' ensembles into one
   SUPER-STACK (`ops.predict.stack_ensemble_group`) and scores a mixed
-  batch in ONE launch: every row walks every tree, per-tenant static
-  segment reductions recover exactly the sums each tenant's solo stack
-  would produce (`_grouped_sums` — bitwise-identical by construction),
-  and a per-row tenant-id gather demuxes the answers.
+  batch in ONE launch.  The traversal is the ``costack_kernel`` dial
+  (config.COSTACK_KERNELS): ``stacked`` walks every row through every
+  stacked tree (free where launch overhead dominates), ``segment``
+  gathers only the row's own tenant's tree segment per depth level
+  (`predict_ensemble_grouped_segment*` — node math back to ~1x a solo
+  tenant's on compute-bound tiers), and ``auto`` resolves per backend
+  (`ops.predict.resolve_costack_kernel`).  Either way per-tenant
+  reductions recover exactly the sums each tenant's solo stack would
+  produce (bitwise-identical by construction), and a per-row
+  tenant-id gather demuxes the answers.
 - The tenant id rides as ONE extra trailing buffer column (exact in
   f32 below 2^24; fits the uint8/uint16 binned buffer for up to
   ``MAX_GROUP_TENANTS`` members), so the entire PredictorRuntime
@@ -29,8 +35,9 @@ trees across MODELS:
   tree — bounds padding waste: node records pad to the group's widest
   tree, so grouping a 4096-leaf model with 15-leaf models would pay a
   ~256x record-footprint tax on every small tenant's rows.  Tenants
-  with a per-tenant ``replicas`` override, ``costack=off``, or no
-  same-key peer serve solo exactly as before.
+  with ``costack=off`` or no same-key peer serve solo exactly as
+  before; a group's replica fleet sizes to the MAX of its members'
+  per-tenant ``replicas`` overrides (catalog._group_replicas).
 - A member hot swap RESTACKS its group (catalog._restack): a new
   GroupRuntime is built from the members' current runtimes, and when
   the program signature is unchanged (same stack shapes/dtypes, same
@@ -89,6 +96,31 @@ def group_id_for(key: Tuple[int, str, int], chunk: int = 0) -> str:
     return base if chunk == 0 else f"{base}.{chunk}"
 
 
+def _quantizer_signature(q) -> Optional[tuple]:
+    """Content identity of a member's frozen ingress mapper set
+    (quantize.FeatureQuantizer): two binned members share ingress
+    quantization iff their signatures match — the same-refbin
+    condition of the shared ingress quantizer.  Hashes the mapper
+    TABLES, not the sidecar path, so two publishes of one refbin (or
+    byte-identical copies) still dedup."""
+    if q is None:
+        return None
+    import hashlib
+    h = hashlib.sha1()
+    h.update(np.asarray(q.used_features, np.int64).tobytes())
+    for isnum, tbl in zip(q._numeric, q._tables):
+        if isnum:
+            h.update(b"n")
+            h.update(np.ascontiguousarray(tbl).tobytes())
+        else:
+            cats, bins = tbl
+            h.update(b"c")
+            h.update(np.ascontiguousarray(cats).tobytes())
+            h.update(np.ascontiguousarray(bins).tobytes())
+    return (q.num_total_features, q.num_columns, str(np.dtype(q.dtype)),
+            int(q.missing_bin), h.hexdigest())
+
+
 def _value_signature(runtime: PredictorRuntime):
     """Hashable identity of a member's fused device transform — part of
     the group program signature (transplanting executables across a
@@ -116,8 +148,10 @@ class GroupRuntime(PredictorRuntime):
                  runtimes: Sequence[PredictorRuntime], *,
                  group_id: str, generation: int = 1, replicas: int = 0,
                  failure_threshold: int = 3,
-                 probe_after: Optional[int] = None):
-        from ..ops.predict import stack_ensemble_group
+                 probe_after: Optional[int] = None,
+                 costack_kernel: str = "auto"):
+        from ..ops.predict import (resolve_costack_kernel,
+                                   stack_ensemble_group)
         if len(member_ids) != len(runtimes) or not runtimes:
             raise LightGBMError("GroupRuntime needs aligned, non-empty "
                                 "member ids and runtimes")
@@ -155,6 +189,11 @@ class GroupRuntime(PredictorRuntime):
             [rt._trees_by_class for rt in runtimes], binned=binned)
         self._gmeta = gmeta
         self._meta = None
+        # grouped-traversal strategy, resolved ONCE per group build (the
+        # dial is fleet-wide; the resolved value is part of the program
+        # signature so a transplant can never cross segment<->stacked)
+        self.costack_kernel = resolve_costack_kernel(
+            costack_kernel, total_trees=int(gmeta.segments[-1][1]))
         # the shared request buffer: every member's data columns padded
         # to the group-wide max, plus ONE trailing tenant-id column.  A
         # member's trees never gather beyond its own columns, and
@@ -178,8 +217,20 @@ class GroupRuntime(PredictorRuntime):
             (v for v in self._member_values if v is not None), None)
         # hashable program identity for executable transplants across
         # restacks (adopt_cache_from)
+        # shared ingress quantizer (ROADMAP 2d): when every binned
+        # member froze the SAME mapper set (same-refbin publish) with
+        # the same feature-count contract, a mixed batch quantizes ONCE
+        # against it instead of once per member job
+        # (serve/group_quantize_shared counts the deduped rows)
+        self._shared_quantizer = None
+        if binned and len({rt.num_features for rt in runtimes}) == 1:
+            sigs = {_quantizer_signature(rt._quantizer)
+                    for rt in runtimes}
+            if len(sigs) == 1 and None not in sigs:
+                self._shared_quantizer = runtimes[0]._quantizer
         self._signature = (
-            self.variant, str(np.dtype(self._buf_dtype)), self._buf_cols,
+            self.variant, self.costack_kernel,
+            str(np.dtype(self._buf_dtype)), self._buf_cols,
             self._gmeta, tuple(_value_signature(rt) for rt in runtimes),
             self.K, self.min_bucket_rows, self.max_batch_rows,
             tuple((tuple(a.shape), str(a.dtype)) for a in stack),
@@ -190,21 +241,25 @@ class GroupRuntime(PredictorRuntime):
 
     def _program(self, kind: str):
         import jax.numpy as jnp
-        from ..ops.predict import (predict_ensemble_grouped,
-                                   predict_ensemble_grouped_binned)
+        from ..ops.predict import (
+            predict_ensemble_grouped, predict_ensemble_grouped_binned,
+            predict_ensemble_grouped_segment,
+            predict_ensemble_grouped_segment_binned)
         meta = self._gmeta
         binned = self.variant == "binned"
+        if self.costack_kernel == "segment":
+            kernel = (predict_ensemble_grouped_segment_binned if binned
+                      else predict_ensemble_grouped_segment)
+        else:
+            kernel = (predict_ensemble_grouped_binned if binned
+                      else predict_ensemble_grouped)
         transforms = ([(g, v) for g, v in enumerate(self._member_values)
                        if v is not None] if kind == "value" else [])
 
         def fn(stacks, Xt):
             X = Xt[:, :-1]
             tids = Xt[:, -1].astype(jnp.int32)
-            raw = (predict_ensemble_grouped_binned(stacks, X, tids,
-                                                   meta=meta)
-                   if binned
-                   else predict_ensemble_grouped(stacks, X, tids,
-                                                 meta=meta))
+            raw = kernel(stacks, X, tids, meta=meta)
             if transforms:
                 # per-member fused transforms behind a row mask: the
                 # transform is elementwise, so the selected rows carry
@@ -261,12 +316,10 @@ class GroupRuntime(PredictorRuntime):
             "GroupRuntime serves mixed batches via predict_mixed(jobs); "
             "single-tenant predict has no tenant id to route by")
 
-    def _prep_member_rows(self, g: int, X: np.ndarray) -> np.ndarray:
-        """One member's request rows → group-buffer rows: validate the
-        width against the MEMBER's contract (solo semantics: wider
-        trims, narrower errors), quantize with the member's own
-        quantizer under the binned variant, zero-pad to the group data
-        columns, stamp the tenant id into the trailing column."""
+    def _validate_member_rows(self, g: int, X: np.ndarray) -> np.ndarray:
+        """One member's request rows validated against the MEMBER's
+        width contract (solo semantics: wider trims, narrower errors)
+        — float64, 2-D, contiguous; quantization not yet applied."""
         rt = self.members[g]
         X = np.ascontiguousarray(np.asarray(X, np.float64))
         if X.ndim == 1:
@@ -277,6 +330,16 @@ class GroupRuntime(PredictorRuntime):
             raise LightGBMError(
                 f"request has {X.shape[1]} features, model "
                 f"{self.member_ids[g]!r} expects {rt.num_features}")
+        return X
+
+    def _prep_member_rows(self, g: int, X: np.ndarray) -> np.ndarray:
+        """One member's request rows → group-buffer rows: validate the
+        width, quantize with the member's OWN quantizer under the
+        binned variant (the mixed-mapper path — same-refbin groups
+        quantize once in `_mux_jobs` instead), zero-pad to the group
+        data columns, stamp the tenant id into the trailing column."""
+        rt = self.members[g]
+        X = self._validate_member_rows(g, X)
         if rt._quantizer is not None:
             X = rt._quantizer.quantize(X)
             profiling.count(profiling.SERVE_QUANTIZE_BYTES_IN, X.nbytes)
@@ -284,6 +347,41 @@ class GroupRuntime(PredictorRuntime):
         buf[:, :X.shape[1]] = X
         buf[:, -1] = g
         return buf
+
+    def _mux_jobs(self, jobs: Sequence[Tuple[int, np.ndarray]]
+                  ) -> Tuple[Optional[np.ndarray], List[int]]:
+        """Mixed jobs → (the [total, buf_cols] group buffer, per-job row
+        counts); the buffer is None on an all-empty batch.  With a
+        shared ingress quantizer (same-refbin binned group) the WHOLE
+        mixed batch quantizes in ONE pass against the common mapper set
+        instead of once per member job — pure host-CPU dedup, the bin
+        ids are identical by construction (one quantizer, same rows)."""
+        if self._shared_quantizer is None:
+            bufs = [self._prep_member_rows(g, X) for g, X in jobs]
+            counts = [b.shape[0] for b in bufs]
+            if sum(counts) == 0:
+                return None, counts
+            return (bufs[0] if len(bufs) == 1
+                    else np.concatenate(bufs, axis=0)), counts
+        raws = [self._validate_member_rows(g, X) for g, X in jobs]
+        counts = [r.shape[0] for r in raws]
+        total = int(sum(counts))
+        if total == 0:
+            return None, counts
+        Xcat = raws[0] if len(raws) == 1 else np.concatenate(raws, axis=0)
+        q = self._shared_quantizer.quantize(Xcat)
+        profiling.count(profiling.SERVE_QUANTIZE_BYTES_IN, q.nbytes)
+        profiling.count(profiling.SERVE_GROUP_QUANTIZE_SHARED, total)
+        profiling.count(profiling.labeled(
+            profiling.SERVE_GROUP_QUANTIZE_SHARED,
+            group=self.model_id), total)
+        Xt = np.zeros((total, self._buf_cols), self._buf_dtype)
+        Xt[:, :q.shape[1]] = q
+        off = 0
+        for (g, _X), n in zip(jobs, counts):
+            Xt[off:off + n, -1] = g
+            off += n
+        return Xt, counts
 
     def predict_mixed(self, jobs: Sequence[Tuple[int, np.ndarray]],
                       kind: str = "value") -> List[np.ndarray]:
@@ -295,13 +393,11 @@ class GroupRuntime(PredictorRuntime):
         if kind not in OUTPUT_KINDS:
             raise ValueError(
                 f"unknown output kind {kind!r}; use one of {OUTPUT_KINDS}")
-        bufs = [self._prep_member_rows(g, X) for g, X in jobs]
-        counts = [b.shape[0] for b in bufs]
+        Xt, counts = self._mux_jobs(jobs)
         total = int(sum(counts))
-        if total == 0:
+        if Xt is None:
             empty = np.zeros(0) if self.K == 1 else np.zeros((0, self.K))
             return [empty.copy() for _ in jobs]
-        Xt = bufs[0] if len(bufs) == 1 else np.concatenate(bufs, axis=0)
         if self.variant == "binned":
             profiling.count(profiling.SERVE_BINNED_REQUESTS)
         run_kind = self._run_kind(kind)
@@ -333,4 +429,12 @@ class GroupRuntime(PredictorRuntime):
                 out = rt.objective.convert_output(out)
             outs.append(out)
         profiling.count("serve.rows", total)
+        # per-group demux row accounting by RESOLVED traversal kernel
+        # (/stats groups block, /metrics, bench_serve_mt's A/B proof)
+        rows_name = (profiling.SERVE_GROUP_SEGMENT_ROWS
+                     if self.costack_kernel == "segment"
+                     else profiling.SERVE_GROUP_STACKED_ROWS)
+        profiling.count(rows_name, total)
+        profiling.count(profiling.labeled(rows_name, group=self.model_id),
+                        total)
         return outs

@@ -32,11 +32,20 @@ to a file.  Gates (all fire after the JSON):
 
 - compile ratio (off/on) >= ``SERVE_MT_REQUIRE_RATIO`` (default 5) at
   every tenant count >= 10 — the acceptance bar of the co-stack PR;
-- on-side p99 <= off-side p99 * ``SERVE_MT_REQUIRE_P99`` when that
-  knob is set (off by default: closed-loop CPU p99 is noisy, the
-  chip-queue TPU stage opts in);
+- on-side p99 <= off-side p99 * ``SERVE_MT_REQUIRE_P99`` (default
+  1.15) at every tenant count >= 100 — the compute-bound bar of the
+  segment-kernel PR: under ``costack_kernel=auto`` the CPU tier
+  resolves to the segment-gathered walk, so the on side must no
+  longer pay the walk-everyone G× node math that made large-fleet
+  co-stacking a latency regression (0 disables; smaller counts stay
+  report-only — closed-loop CPU p99 is noisy at low load);
 - steady-state misses == 0 on both sides;
 - per-tenant parity is always a hard gate.
+
+Per on-side record the resolved kernel variant rides along with the
+``serve/group_segment_rows`` / ``serve/group_stacked_rows`` /
+``serve/group_quantize_shared`` counter deltas, so the JSON itself
+proves WHICH traversal served the load window.
 
 Env knobs: SERVE_MT_TENANTS ("10,100" — comma list),
 SERVE_MT_DISTINCT (4 distinct fits cycled across tenant ids),
@@ -45,7 +54,8 @@ SERVE_MT_ROWS (rows/request, 32), SERVE_MT_WORKERS (8),
 SERVE_MT_SECONDS (6, per side), SERVE_MT_MAX_BATCH (256),
 SERVE_MT_REPLICAS (0 = auto), SERVE_MT_OUT,
 SERVE_MT_REQUIRE_RATIO (5.0; 0 disables), SERVE_MT_REQUIRE_P99
-(p99 slack multiplier; 0 = report only).
+(p99 slack multiplier, default 1.15 at >= 100 tenants; 0 = report
+only), SERVE_MT_KERNEL (costack_kernel for the on side; "auto").
 """
 import json
 import math
@@ -74,7 +84,8 @@ SECONDS = float(os.environ.get("SERVE_MT_SECONDS", 6))
 MAX_BATCH = int(os.environ.get("SERVE_MT_MAX_BATCH", 256))
 REPLICAS = int(os.environ.get("SERVE_MT_REPLICAS", 0))
 REQUIRE_RATIO = float(os.environ.get("SERVE_MT_REQUIRE_RATIO", 5.0))
-REQUIRE_P99 = float(os.environ.get("SERVE_MT_REQUIRE_P99", 0))
+REQUIRE_P99 = float(os.environ.get("SERVE_MT_REQUIRE_P99", 1.15))
+KERNEL = os.environ.get("SERVE_MT_KERNEL", "auto")
 FEATURES = 16
 
 
@@ -171,11 +182,15 @@ def _run_side(models, tenant_ids, X, Xfix, costack, warm, san_label,
 
     miss0 = profiling.counter_value("serve.cache_miss")
     gc0 = profiling.counter_value(profiling.SERVE_GROUP_COMPILES)
+    seg0 = profiling.counter_value(profiling.SERVE_GROUP_SEGMENT_ROWS)
+    stk0 = profiling.counter_value(profiling.SERVE_GROUP_STACKED_ROWS)
+    shq0 = profiling.counter_value(profiling.SERVE_GROUP_QUANTIZE_SHARED)
     t0 = time.monotonic()
     catalog = ModelCatalog(models, params={"verbose": -1},
                            max_batch_rows=MAX_BATCH,
                            flush_deadline_ms=2.0, replicas=REPLICAS,
-                           warmup_buckets=warm, costack=costack)
+                           warmup_buckets=warm, costack=costack,
+                           costack_kernel=KERNEL)
     build_s = time.monotonic() - t0
     try:
         parity = {}
@@ -198,6 +213,18 @@ def _run_side(models, tenant_ids, X, Xfix, costack, warm, san_label,
             rec["groups"] = len(catalog._groups)
             rec["group_compiles"] = (profiling.counter_value(
                 profiling.SERVE_GROUP_COMPILES) - gc0)
+            # which traversal actually served the window: the resolved
+            # kernel per group plus the canonical row counters' deltas
+            # (segment vs stacked are mutually exclusive per group)
+            rec["costack_kernel"] = sorted({
+                g.current().costack_kernel
+                for g in catalog._groups.values()})
+            rec["segment_rows"] = (profiling.counter_value(
+                profiling.SERVE_GROUP_SEGMENT_ROWS) - seg0)
+            rec["stacked_rows"] = (profiling.counter_value(
+                profiling.SERVE_GROUP_STACKED_ROWS) - stk0)
+            rec["quantize_shared_rows"] = (profiling.counter_value(
+                profiling.SERVE_GROUP_QUANTIZE_SHARED) - shq0)
             rec["group_stats"] = catalog.group_stats()
         if sanitize_enabled():
             # single-threaded steady-state probe (the transfer guard is
@@ -291,7 +318,7 @@ def main() -> None:
                         f"{n} tenants ({side}): "
                         f"{rec['steady_state_misses']} request-path "
                         "compiles after warmup")
-            if (REQUIRE_P99 and "error" not in on["load"]
+            if (REQUIRE_P99 and n >= 100 and "error" not in on["load"]
                     and "error" not in off["load"]
                     and on["load"]["p99_ms"]
                     > off["load"]["p99_ms"] * REQUIRE_P99):

@@ -155,6 +155,248 @@ def test_mixed_batch_bitwise_binned_heterogeneous_widths(tmp_path):
         cat.close()
 
 
+# -- tentpole: segment-gathered traversal --------------------------------
+
+
+KERNELS = ("stacked", "segment")
+
+
+@pytest.mark.parametrize("kernel", KERNELS)
+def test_kernel_variant_bitwise_heterogeneous_trees(tmp_path, kernel):
+    """BOTH traversal kernels answer a heterogeneous group (different
+    rounds AND leaf counts per tenant inside one leaf tier, so the
+    super-stack mixes tree counts and depths) bitwise vs solo dispatch;
+    the group pins the requested kernel and the matching canonical row
+    counter — and ONLY that one — moves during the mixed round."""
+    pubs = {mid: _publish(tmp_path, mid, seed, rounds=r, leaves=lv)
+            for mid, (seed, r, lv) in (("short", (51, 2, 9)),
+                                       ("mid", (52, 5, 15)),
+                                       ("deep", (53, 3, 12)))}
+    cat = ModelCatalog({mid: p for mid, (p, _b, _x) in pubs.items()},
+                       params={"verbose": -1}, serve_quantize="raw",
+                       costack_kernel=kernel)
+    try:
+        (group,) = cat._groups.values()
+        assert sorted(group.member_ids) == ["deep", "mid", "short"]
+        assert group.runtime.costack_kernel == kernel
+        jobs = {mid: pubs[mid][2][:7 + 2 * i]       # uneven row counts
+                for i, mid in enumerate(pubs)}
+        total = sum(len(X) for X in jobs.values())
+        seg0 = profiling.counter_value(profiling.SERVE_GROUP_SEGMENT_ROWS)
+        stk0 = profiling.counter_value(profiling.SERVE_GROUP_STACKED_ROWS)
+        for kind in ("value", "raw"):
+            got = _mixed_round(cat, jobs, kind=kind)
+            for mid, (_p, bst, _X) in pubs.items():
+                want = _solo(bst).predict(jobs[mid], kind=kind)
+                assert np.array_equal(got[mid], want), (mid, kind, kernel)
+        seg = profiling.counter_value(profiling.SERVE_GROUP_SEGMENT_ROWS) - seg0
+        stk = profiling.counter_value(profiling.SERVE_GROUP_STACKED_ROWS) - stk0
+        if kernel == "segment":
+            assert (seg, stk) == (2 * total, 0)
+        else:
+            assert (seg, stk) == (0, 2 * total)
+    finally:
+        cat.close()
+
+
+@pytest.mark.parametrize("kernel", KERNELS)
+def test_kernel_variant_bitwise_multiclass(tmp_path, kernel):
+    """Multiclass (K=3) heterogeneous-round tenants demux bitwise under
+    both kernels — the segment walk's per-class demux must reduce in
+    the exact order of the solo per-class segment-sum."""
+    pubs = {mid: _publish(tmp_path, mid, seed, num_class=3, rounds=r)
+            for mid, (seed, r) in (("mc1", (61, 3)), ("mc2", (62, 5)))}
+    cat = ModelCatalog({mid: p for mid, (p, _b, _x) in pubs.items()},
+                       params={"verbose": -1}, serve_quantize="raw",
+                       costack_kernel=kernel)
+    try:
+        (group,) = cat._groups.values()
+        assert group.runtime.K == 3
+        assert group.runtime.costack_kernel == kernel
+        jobs = {mid: pubs[mid][2][:9] for mid in pubs}
+        for kind in ("value", "raw"):
+            got = _mixed_round(cat, jobs, kind=kind)
+            for mid, (_p, bst, _X) in pubs.items():
+                want = _solo(bst).predict(jobs[mid], kind=kind)
+                assert np.array_equal(got[mid], want), (mid, kind, kernel)
+    finally:
+        cat.close()
+
+
+@pytest.mark.parametrize("kernel", KERNELS)
+def test_kernel_variant_bitwise_binned(tmp_path, kernel):
+    """The binned twins: quantized-ingress heterogeneous-width groups
+    answer bitwise under both kernels (integer compares end to end)."""
+    pubs = {mid: _publish(tmp_path, mid, seed, refbin=True, features=feat)
+            for mid, (seed, feat) in (("bn", (71, 8)), ("bw", (72, 12)))}
+    cat = ModelCatalog({mid: p for mid, (p, _b, _x) in pubs.items()},
+                       params={"verbose": -1}, serve_quantize="binned",
+                       costack_kernel=kernel)
+    try:
+        (group,) = cat._groups.values()
+        assert group.runtime.variant == "binned"
+        assert group.runtime.costack_kernel == kernel
+        # different feature sets -> different mapper tables -> NO
+        # shared ingress quantizer for this group
+        assert group.runtime._shared_quantizer is None
+        from lightgbm_tpu.quantize import load_refbin
+        jobs = {mid: pubs[mid][2][:10] for mid in pubs}
+        got = _mixed_round(cat, jobs)
+        for mid, (p, bst, _X) in pubs.items():
+            rb = load_refbin(p + ".refbin")
+            want = _solo(bst, quantize="binned", refbin=rb).predict(jobs[mid])
+            assert np.array_equal(got[mid], want), (mid, kernel)
+    finally:
+        cat.close()
+
+
+def test_auto_kernel_resolves_segment_on_cpu(tmp_path):
+    """`costack_kernel=auto` (the default) resolves to the
+    segment-gathered walk on the CPU backend — compute-bound tiers must
+    not pay the walk-everyone node math; `stacked` stays available as
+    an explicit pin and bogus names are rejected."""
+    from lightgbm_tpu.ops.predict import (COSTACK_SEGMENT_TREES,
+                                          resolve_costack_kernel)
+    assert resolve_costack_kernel("auto") == "segment"
+    assert resolve_costack_kernel("stacked") == "stacked"
+    assert resolve_costack_kernel(
+        "auto", total_trees=COSTACK_SEGMENT_TREES + 1) == "segment"
+    with pytest.raises(ValueError):
+        resolve_costack_kernel("fast")
+    pubs = {mid: _publish(tmp_path, mid, seed)
+            for mid, seed in (("u", 73), ("v", 74))}
+    cat = ModelCatalog({mid: p for mid, (p, _b, _x) in pubs.items()},
+                       params={"verbose": -1}, serve_quantize="raw")
+    try:
+        (group,) = cat._groups.values()
+        assert group.runtime.costack_kernel == "segment"
+    finally:
+        cat.close()
+
+
+def test_segment_single_tenant_group(tmp_path):
+    """A single-member group under the segment kernel is the degenerate
+    case (every row gathers the whole stack) and must stay bitwise."""
+    _p, bst, X = _publish(tmp_path, "solo1", 75)
+    rt = resolve_runtime(bst, serve_quantize="raw")
+    g = GroupRuntime(["solo1"], [rt], group_id="~g.test",
+                     costack_kernel="segment")
+    (got,) = g.predict_mixed([(0, X[:11])])
+    assert np.array_equal(np.asarray(got), _solo(bst).predict(X[:11]))
+
+
+def test_segment_padded_remainder_chunks(tmp_path):
+    """A mixed batch larger than max_batch_rows splits into chunks with
+    a padded remainder; padded slots walk a clamped tree and contribute
+    exact zeros, so every chunk stays bitwise under the segment
+    kernel."""
+    pubs = {mid: _publish(tmp_path, mid, seed)
+            for mid, seed in (("pa", 76), ("pb", 77))}
+    cat = ModelCatalog({mid: p for mid, (p, _b, _x) in pubs.items()},
+                       params={"verbose": -1}, serve_quantize="raw",
+                       costack_kernel="segment", max_batch_rows=8)
+    try:
+        jobs = {"pa": pubs["pa"][2][:13], "pb": pubs["pb"][2][:11]}
+        got = _mixed_round(cat, jobs)
+        for mid, (_p, bst, _X) in pubs.items():
+            assert np.array_equal(got[mid], _solo(bst).predict(jobs[mid]))
+    finally:
+        cat.close()
+
+
+def test_restack_transplant_under_segment_kernel(tmp_path):
+    """The same-shape-republish executable transplant (PR 17) holds
+    under the segment kernel: a signature-preserving restack reuses the
+    compiled segment program with ZERO new compiles and stays
+    bitwise."""
+    pubs = {mid: _publish(tmp_path, mid, seed)
+            for mid, seed in (("sa", 78), ("sb", 79))}
+    cat = ModelCatalog({mid: p for mid, (p, _b, _x) in pubs.items()},
+                       params={"verbose": -1}, serve_quantize="raw",
+                       costack_kernel="segment")
+    try:
+        (group,) = cat._groups.values()
+        assert group.runtime.costack_kernel == "segment"
+        Xq = pubs["sa"][2][:8]
+        cat.submit(Xq, model_id="sa")[1].result(timeout=60)
+        want = _solo(pubs["sa"][1]).predict(Xq)
+        time.sleep(0.01)
+        with open(pubs["sa"][0], "a") as f:
+            f.write("\n")
+        os.utime(pubs["sa"][0])
+        misses = profiling.counter_value("serve.cache_miss")
+        r0 = profiling.counter_value(profiling.SERVE_GROUP_RESTACKS)
+        cat.poll_once()
+        assert (profiling.counter_value(profiling.SERVE_GROUP_RESTACKS)
+                - r0) == 1
+        assert profiling.counter_value("serve.cache_miss") == misses
+        got = cat.submit(Xq, model_id="sa")[1].result(timeout=60)
+        assert np.array_equal(got, want)
+        assert profiling.counter_value("serve.cache_miss") == misses
+    finally:
+        cat.close()
+
+
+def test_kernel_in_program_signature(tmp_path):
+    """segment and stacked programs index trees differently, so the
+    transplant signature must differ between them — a kernel flip on
+    republish recompiles instead of transplanting a wrong-shaped
+    executable."""
+    _p, bst, _X = _publish(tmp_path, "sig", 80)
+    groups = [GroupRuntime(["sig"],
+                           [resolve_runtime(bst, serve_quantize="raw")],
+                           group_id="~g.sig", costack_kernel=kern)
+              for kern in KERNELS]
+    assert groups[0]._signature != groups[1]._signature
+
+
+# -- satellite: shared ingress quantizer ---------------------------------
+
+
+def test_segment_binned_shared_quantizer(tmp_path):
+    """Binned members whose refbin sidecars carry the SAME mapper
+    tables (models trained on one feature matrix) share ONE ingress
+    quantizer: the mixed batch quantizes once, the
+    serve/group_quantize_shared counter moves by the batch's rows, and
+    the answers stay bitwise."""
+    rng = np.random.RandomState(85)
+    X = rng.rand(500, 10)
+    paths = {}
+    boosters = {}
+    for i, mid in enumerate(("qa", "qb")):
+        r2 = np.random.RandomState(86 + i)
+        z = X @ r2.randn(10)
+        y = (z > np.median(z)).astype(float)
+        ds = lgb.Dataset(X, y)
+        bst = lgb.Booster({"objective": "binary", "num_leaves": 15,
+                           "min_data_in_leaf": 5, "verbose": -1}, ds)
+        for _ in range(3 + i):
+            bst.update()
+        path = str(tmp_path / f"{mid}.txt")
+        bst.save_model(path)
+        ds.construct()._inner.save_refbin(path + ".refbin")
+        paths[mid], boosters[mid] = path, bst
+    cat = ModelCatalog(paths, params={"verbose": -1},
+                       serve_quantize="binned", costack_kernel="segment")
+    try:
+        (group,) = cat._groups.values()
+        assert group.runtime._shared_quantizer is not None
+        jobs = {"qa": X[:9], "qb": X[9:16]}
+        sh0 = profiling.counter_value(
+            profiling.SERVE_GROUP_QUANTIZE_SHARED)
+        got = _mixed_round(cat, jobs)
+        assert (profiling.counter_value(
+            profiling.SERVE_GROUP_QUANTIZE_SHARED) - sh0) == 16
+        from lightgbm_tpu.quantize import load_refbin
+        for mid in paths:
+            rb = load_refbin(paths[mid] + ".refbin")
+            want = _solo(boosters[mid], quantize="binned",
+                         refbin=rb).predict(jobs[mid])
+            assert np.array_equal(got[mid], want), mid
+    finally:
+        cat.close()
+
+
 # -- compatibility policy ------------------------------------------------
 
 
@@ -385,8 +627,10 @@ def test_serve_models_override_grammar():
 
 
 def test_override_opts_tenant_out_of_group(tmp_path):
-    """`;costack=off` and `;replicas=` entry overrides force their
-    tenant solo while compatible peers still group; the per-tenant
+    """`;costack=off` entry overrides force their tenant solo while
+    compatible peers still group (`;replicas=` no longer does — it
+    sizes the shared fleet instead, see
+    test_replicas_override_sizes_group_fleet); the per-tenant
     `max_pending_rows` override lands on the shared batcher's
     admission map."""
     pubs = {mid: _publish(tmp_path, mid, seed)
@@ -408,6 +652,36 @@ def test_override_opts_tenant_out_of_group(tmp_path):
         for mid in pubs:
             assert np.array_equal(
                 got[mid], _solo(pubs[mid][1]).predict(pubs[mid][2][:6]))
+    finally:
+        cat.close()
+
+
+def test_replicas_override_sizes_group_fleet(tmp_path):
+    """`;replicas=` no longer opts a tenant out of co-stacking: the
+    overridden tenants still group with their peers and the group's
+    replica fleet sizes to the MAX of the members' overrides (the
+    hottest member sizes the shared fleet)."""
+    pubs = {mid: _publish(tmp_path, mid, seed)
+            for mid, seed in (("ra", 108), ("rb", 109), ("rc", 110))}
+    entries = parse_serve_models((
+        f"ra={pubs['ra'][0]};replicas=3",
+        f"rb={pubs['rb'][0]};replicas=2",
+        f"rc={pubs['rc'][0]}",
+    ))
+    cat = ModelCatalog(dict(entries), params={"verbose": -1},
+                       serve_quantize="raw")
+    try:
+        (group,) = cat._groups.values()
+        assert sorted(group.member_ids) == ["ra", "rb", "rc"]
+        # the catalog policy itself (resolve_serve_replicas later caps
+        # the realized fleet at the device count, so assert the policy)
+        assert cat._group_replicas(["ra", "rb", "rc"]) == 3
+        assert cat._group_replicas(["rb", "rc"]) == 2
+        assert cat._group_replicas(["rc"]) == cat._replicas
+        got = _mixed_round(cat, {mid: pubs[mid][2][:5] for mid in pubs})
+        for mid in pubs:
+            assert np.array_equal(
+                got[mid], _solo(pubs[mid][1]).predict(pubs[mid][2][:5]))
     finally:
         cat.close()
 

@@ -195,12 +195,14 @@ def test_route_config_keys_aliases_and_validation():
         "route_health_ms": 250,
         "backend_timeout_ms": 5000,
         "route_inflight_cap": 64,
+        "route_group_spread": 2,
     })
     assert cfg.route_backends == ("127.0.0.1:9000", "127.0.0.1:9001")
     assert cfg.route_port == 8191
     assert cfg.route_health_interval_ms == 250
     assert cfg.route_backend_timeout_ms == 5000
     assert cfg.route_max_inflight == 64
+    assert cfg.route_group_spread == 2
     with pytest.raises(ValueError):
         config_from_params({"route_port": 99999})
     with pytest.raises(ValueError):
@@ -209,6 +211,8 @@ def test_route_config_keys_aliases_and_validation():
         config_from_params({"route_backend_timeout_ms": 0})
     with pytest.raises(ValueError):
         config_from_params({"route_max_inflight": -1})
+    with pytest.raises(ValueError):
+        config_from_params({"route_group_spread": 0})
     with pytest.raises(LightGBMError):        # router with no fleet
         router_from_config(config_from_params({"task": "route"}))
 
@@ -519,5 +523,122 @@ def test_router_stats_and_metrics_aggregation():
                     in text)
             assert "lgbt_route_healthy_backends 2" in text
     finally:
+        for s in stubs:
+            s.stop()
+
+# -- co-stack-aware placement --------------------------------------------
+
+
+def _health_with_groups(models, group_keys):
+    return {"status": "ok", "generation": 1,
+            "models": {m: 1 for m in models},
+            "published": {m: 1 for m in models}, "stale": [],
+            "groups": 1, "group_keys": group_keys}
+
+
+def test_group_affinity_places_same_key_tenants_together():
+    """Tenants sharing a co-stack group key (learned from the backends'
+    /healthz sweeps) hash the ring by the KEY, not the model id — they
+    all land on one backend and actually co-stack there.  Unknown
+    tenants keep per-model placement, and /stats surfaces the merged
+    placement map."""
+    mids = [f"g{i}" for i in range(8)]
+    gk = "~g.k1.raw.l16"
+    # split the fleet's knowledge across the two backends: the router
+    # must MERGE, not replace, across sweeps
+    h0 = _health_with_groups(mids[:4], {m: gk for m in mids[:4]})
+    h1 = _health_with_groups(mids[4:], {m: gk for m in mids[4:]})
+    stubs = [_StubBackend("s0", health=h0), _StubBackend("s1", health=h1)]
+    rt = _router(stubs)
+    try:
+        # per-model hashing scatters these ids across the fleet — the
+        # baseline the group key collapses (sha1 placement: stable)
+        assert len({rt.ring.place(m) for m in mids}) > 1
+        rt.probe_backends_once()
+        homes = {rt._place_home(m) for m in mids}
+        assert len(homes) == 1
+        # live traffic agrees with the placement map
+        served = set()
+        for m in mids:
+            _s, _h, text = rt.proxy(m, b"[1.0]", "", {"X-Model-Id": m})
+            served.add(json.loads(text)["backend"])
+        assert len(served) == 1
+        # a tenant no backend reported keeps per-model placement
+        assert rt._placement_key("loner") == "loner"
+        with rt:
+            status, text = _get(rt.host, rt.port, "/stats")
+        assert status == 200
+        stats = json.loads(text)
+        assert stats["group_keys"] == {m: gk for m in mids}
+        assert stats["group_spread"] == 1
+    finally:
+        rt._httpd.server_close()
+        for s in stubs:
+            s.stop()
+
+
+def test_drained_group_replaces_together_and_returns_home():
+    """When a group's home backend trips its breaker, every tenant of
+    the group re-places onto the SAME survivor (the group re-forms
+    there — one compile, not G solo tenants), and readmission returns
+    the whole group home."""
+    mids = ["da", "db", "dc"]
+    gk = "~g.k1.raw.l16"
+    h = _health_with_groups(mids, {m: gk for m in mids})
+    stubs = [_StubBackend(f"s{i}", health=h) for i in range(3)]
+    rt = _router(stubs, failure_threshold=1)
+    try:
+        rt.probe_backends_once()
+        home = rt._place_home(mids[0])
+        assert {rt._place_home(m) for m in mids} == {home}
+        b_home = rt._backends[home]
+        by_name = {s.addr: s.name for s in stubs}
+        # one transport failure opens the home breaker; the request
+        # retries onto a survivor and the client stays green
+        faults.arm(f"route.backend.b{b_home.index}:1")
+        status, _h2, text = rt.proxy(mids[0], b"[1.0]", "",
+                                     {"X-Model-Id": mids[0]})
+        assert status == 200 and b_home.broken
+        # EVERY tenant of the drained group re-places onto the same
+        # survivor — placement-key affinity, not per-model scatter
+        survivors = set()
+        for m in mids:
+            _s, _h3, text = rt.proxy(m, b"[1.0]", "", {"X-Model-Id": m})
+            survivors.add(json.loads(text)["backend"])
+        assert len(survivors) == 1
+        assert survivors != {by_name[home]}
+        # drive the half-open probe -> readmission -> the group is home
+        for _ in range(rt.PROBE_AFTER):
+            rt.proxy(mids[0], b"[1.0]", "", {"X-Model-Id": mids[0]})
+        assert not b_home.broken
+        for m in mids:
+            _s, _h4, text = rt.proxy(m, b"[1.0]", "", {"X-Model-Id": m})
+            assert json.loads(text)["backend"] == by_name[home]
+    finally:
+        rt._httpd.server_close()
+        for s in stubs:
+            s.stop()
+
+
+def test_group_spread_shards_cohort_but_keeps_shard_affinity():
+    """route_group_spread > 1 salts the group key with the tenant's own
+    hash point modulo the spread: the cohort splits into at most that
+    many co-located shards instead of one giant home backend."""
+    mids = [f"w{i}" for i in range(12)]
+    gk = "~g.k1.raw.l16"
+    h = _health_with_groups(mids, {m: gk for m in mids})
+    stubs = [_StubBackend(f"s{i}", health=h) for i in range(3)]
+    rt = _router(stubs, group_spread=2)
+    try:
+        rt.probe_backends_once()
+        keys = {m: rt._placement_key(m) for m in mids}
+        assert set(keys.values()) <= {f"{gk}#0", f"{gk}#1"}
+        assert len(set(keys.values())) == 2      # sha1 points: stable
+        # same shard -> same home backend, always
+        for shard in set(keys.values()):
+            cohort = [m for m in mids if keys[m] == shard]
+            assert len({rt._place_home(m) for m in cohort}) == 1
+    finally:
+        rt._httpd.server_close()
         for s in stubs:
             s.stop()
