@@ -213,9 +213,16 @@ class GBDT:
     def add_valid(self, valid_set: Dataset, name: str) -> None:
         self._flush_pending()
         cfg = self.config
-        bins_np = valid_set.bins.astype(np.int32)
-        pad = np.zeros((bins_np.shape[0], 1), np.int32)
-        bins_t = jnp.asarray(np.concatenate([bins_np, pad], axis=1).T.copy())
+        if valid_set.sparse is not None:
+            # sparse valid sets hand the ELL triple: scoring walks the
+            # row segments directly (predict_ensemble_binned_sparse /
+            # the sparse _walk_step) and never densifies
+            bins_t = valid_set.sparse_triple()
+        else:
+            bins_np = valid_set.bins.astype(np.int32)
+            pad = np.zeros((bins_np.shape[0], 1), np.int32)
+            bins_t = jnp.asarray(
+                np.concatenate([bins_np, pad], axis=1).T.copy())
         su = ScoreUpdater(bins_t, valid_set.num_data, self.K,
                           valid_set.metadata.init_score,
                           feat_tbl=valid_set.bundle_feat_table())

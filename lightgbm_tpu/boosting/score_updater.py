@@ -19,6 +19,18 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.lookup import select_bin_by_feature, table_lookup
+from ..ops.predict import sparse_bin_lookup
+
+
+def _bins_rows(bins_t):
+    """(per-row store view, N).  Dense [N+1, C] stores carry a sentinel
+    row that slices off; the sparse ELL triple (cols [N, R], binsv
+    [N, R], zero_bin [C]) has no sentinel — its probe answers every
+    column for every row by construction."""
+    if isinstance(bins_t, (tuple, list)):
+        return tuple(bins_t), bins_t[0].shape[0]
+    N = bins_t.shape[0] - 1
+    return bins_t[:N], N
 
 
 def _walk_step(node, bins_nt, split_feature, threshold, decision,
@@ -28,11 +40,23 @@ def _walk_step(node, bins_nt, split_feature, threshold, decision,
     and 2-D `bins[rows, feat]` gathers serialize on TPU and cost more than
     the whole histogram pass; child ids are exact in f32 (|v| < 2^24).
 
+    bins_nt may be the sparse ELL triple (cols, binsv, zero_bin): the
+    bin lookup then probes the row's stored entries directly
+    (ops/predict.sparse_bin_lookup — compare + masked sum, also
+    gather-free) and the store never densifies.  Decision logic is
+    identical either way.
+
     feat_tbl (optional [5, F]: col, offset, default, nslots, packed) maps
     the node's ORIGINAL inner feature onto a bundled store column and
     recovers the original bin from the packed slot — trees always speak
     original (feature, threshold-bin) space, so an EFB store needs this
     second lookup; unbundled stores skip it entirely."""
+    if isinstance(bins_nt, tuple):
+        def bin_of(c):
+            return sparse_bin_lookup(*bins_nt, c)
+    else:
+        def bin_of(c):
+            return select_bin_by_feature(bins_nt.T, c)
     nd = jnp.maximum(node, 0)
     tbl = jnp.stack([split_feature.astype(jnp.float32),
                      threshold.astype(jnp.float32),
@@ -44,7 +68,7 @@ def _walk_step(node, bins_nt, split_feature, threshold, decision,
     t = r[1].astype(jnp.int32)
     d = r[2]
     if feat_tbl is None:
-        bv = select_bin_by_feature(bins_nt.T, feat)
+        bv = bin_of(feat)
     else:
         fr = table_lookup(jnp.asarray(feat_tbl), feat,
                           num_slots=feat_tbl.shape[1])
@@ -53,7 +77,7 @@ def _walk_step(node, bins_nt, split_feature, threshold, decision,
         dflt = fr[2].astype(jnp.int32)
         ns = fr[3].astype(jnp.int32)
         pk = fr[4] > 0
-        bv_store = select_bin_by_feature(bins_nt.T, col)
+        bv_store = bin_of(col)
         s = bv_store - off
         in_r = (s >= 0) & (s < ns)
         orig = jnp.where(in_r, s + (s >= dflt).astype(jnp.int32), dflt)
@@ -71,12 +95,12 @@ def predict_binned_leaf(bins_t: jax.Array, split_feature_inner: jax.Array,
     """Leaf index per row by walking the tree `depth` levels.
 
     bins_t: [N+1, C] int STORE bins (C = original features, or bundled
-    columns with `feat_tbl` given).  Tree arrays are padded to fixed
-    length so the jit cache keys only on `depth`.
+    columns with `feat_tbl` given), or the sparse ELL triple
+    (cols, binsv, zero_bin) — see _bins_rows.  Tree arrays are padded
+    to fixed length so the jit cache keys only on `depth`.
     """
-    N = bins_t.shape[0] - 1
+    bins_nt, N = _bins_rows(bins_t)
     node = jnp.zeros(N, jnp.int32)
-    bins_nt = bins_t[:N]
     nn = split_feature_inner.shape[0]
 
     def step(_, node):
@@ -97,7 +121,7 @@ def traverse_tree_device(bins_t, split_feature, threshold_bin, is_cat,
     sets without waiting for the tree fetch.  A `while_loop` walks until
     every row parked at a leaf (negative node), so cost tracks the actual
     tree depth instead of a static worst-case bound."""
-    N = bins_t.shape[0] - 1
+    bins_nt, N = _bins_rows(bins_t)
     # stump: everything is leaf 0 (node -1 == ~0) from the start
     n0 = jnp.where(num_leaves < 2, jnp.int32(-1), jnp.int32(0))
     node = jnp.full(N, n0, jnp.int32)
@@ -107,7 +131,6 @@ def traverse_tree_device(bins_t, split_feature, threshold_bin, is_cat,
         i, node = st
         return (i < max_steps) & jnp.any(node >= 0)
 
-    bins_nt = bins_t[:N]
     nn = split_feature.shape[0]
 
     def body(st):
@@ -185,11 +208,12 @@ class ScoreUpdater:
 
     def __init__(self, bins_t, num_data: int, K: int,
                  init_score: Optional[np.ndarray] = None, feat_tbl=None):
-        # bins_t: [N+1, C] array, None, or a ZERO-ARG CALLABLE resolved
-        # on first traversal — sparse training stores must not
-        # materialize their dense [N+1, C] transpose unless a consumer
-        # actually walks trees over it (leaf-partition score updates
-        # never do; docs/Sparse.md)
+        # bins_t: [N+1, C] array, the sparse ELL triple (cols, binsv,
+        # zero_bin), None, or a ZERO-ARG CALLABLE resolved on first
+        # traversal.  Sparse stores hand the triple so every traversal
+        # consumer (replay, valid scoring, refit routing) probes the ELL
+        # segments directly and the store NEVER densifies
+        # (tree/sparse_fallbacks stays 0 — docs/Sparse.md)
         self._bins_src = bins_t
         # [5, F] bundle walk table when bins_t is an EFB store (see
         # _walk_step), None for the plain per-feature layout
@@ -251,8 +275,11 @@ class ScoreUpdater:
         instead of ``len(trees)`` sequential per-tree walk programs.
         Stump constants ride in the stack (leaf 0), so the result matches
         the sequential add_tree/add_constant loop to f32 addition
-        reassociation (exact on dyadic leaf values)."""
+        reassociation (exact on dyadic leaf values).  A sparse store
+        replays through `predict_ensemble_binned_sparse` — same walk,
+        ELL probes instead of dense gathers, zero densification."""
         from ..ops.predict import (build_ensemble, predict_ensemble_binned,
+                                   predict_ensemble_binned_sparse,
                                    resolve_predict_kernel)
         if (resolve_predict_kernel(kernel) != "tensorized"
                 or len(trees) < 2 or self._bins_src is None):
@@ -264,8 +291,13 @@ class ScoreUpdater:
         stack, meta = build_ensemble(trees_by_class, binned=True,
                                      layout="soa")
         stack = jax.device_put(stack)
-        raw = predict_ensemble_binned(stack, self.bins_t, self.feat_tbl,
-                                      meta=meta)                # [K, N]
+        bt = self.bins_t
+        if isinstance(bt, (tuple, list)):
+            raw = predict_ensemble_binned_sparse(
+                stack, *bt, self.feat_tbl, meta=meta)           # [K, N]
+        else:
+            raw = predict_ensemble_binned(stack, bt, self.feat_tbl,
+                                          meta=meta)            # [K, N]
         self.score = _add_raw(self.score, raw)
 
     def add_tree_arrays_dev(self, arrs, leaf_values: jax.Array,

@@ -342,7 +342,8 @@ def dataset_get_subset(ds: CApiDataset, idx_addr: int, num_idx: int,
         cfg, inner.mappers, list(inner.used_features), int(num_idx),
         inner.num_total_features, list(inner.feature_names),
         plan=inner.bundle_plan)
-    sub.bins = np.ascontiguousarray(inner.bins[:, idx])
+    sub.bins = np.ascontiguousarray(
+        inner.dense_bins(site="capi_subset")[:, idx])
     # conflicts of the selected rows are not recoverable from the bundled
     # store; carry a proportional ESTIMATE so realized_conflict_rate()
     # stays in [0, 1] instead of inheriting the full dataset's count

@@ -342,6 +342,8 @@ PARAM_ALIASES: Dict[str, str] = {
     "serve_costack_kernel": "costack_kernel",
     "cross_model_kernel": "costack_kernel",
     "group_kernel": "costack_kernel",
+    "costack_segment_threshold": "costack_segment_trees",
+    "segment_trees_threshold": "costack_segment_trees",
     # router tier (task=route, lightgbm_tpu/router/, docs/Router.md)
     "router_backends": "route_backends",
     "backends": "route_backends",
@@ -721,6 +723,13 @@ class Config:
     # (CPU, or very deep stacks on accelerators) and stacked where
     # launch overhead dominates (ops/predict.resolve_costack_kernel).
     costack_kernel: str = "auto"
+    # costack_kernel=auto's accelerator switch point: total stacked
+    # trees at which even a launch-bound backend goes compute-bound on
+    # the walk-all traversal and `auto` picks "segment".  The
+    # LIGHTGBM_TPU_COSTACK_SEGMENT_TREES env override (read at resolve
+    # time) still wins for fleet-wide emergency retunes without a
+    # config rollout.
+    costack_segment_trees: int = 4096
     # shadow-canary publishes: with a fraction > 0, a republished model
     # is STAGED as a candidate instead of swapped live — this fraction
     # of requests is double-scored on it (stable still answers the
@@ -960,6 +969,8 @@ def check_param_conflict(cfg: Config) -> None:
     if cfg.costack_kernel not in COSTACK_KERNELS:
         raise ValueError(f"unknown costack_kernel: {cfg.costack_kernel}; "
                          f"use one of {COSTACK_KERNELS}")
+    if cfg.costack_segment_trees < 1:
+        raise ValueError("costack_segment_trees must be >= 1")
     if cfg.serve_models:
         parse_serve_models(cfg.serve_models)   # id=path shape + id charset
     if cfg.serve_cache_budget_mb < 0:

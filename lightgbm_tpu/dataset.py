@@ -685,10 +685,14 @@ class Dataset:
         # (src/native/loader.cpp lgbt_bin_numerical); the rest via NumPy
         self._bin_rows_into(X, 0)
         self._check_realized_conflicts()
-        # sparse store: training sets only — valid sets are consumed
-        # dense by the score updater anyway (docs/Sparse.md)
-        if reference is None and resolve_sparse_store(
-                cfg, self.mappers, self.used_features, self.bundle_plan):
+        # sparse store: training sets by the resolver; valid sets follow
+        # their reference's layout — the score updater walks the ELL
+        # segments directly (predict_ensemble_binned_sparse), so a csr
+        # run never densifies for valid-set scoring (docs/Sparse.md)
+        if ((reference is None or reference.sparse is not None)
+                and resolve_sparse_store(
+                    cfg, self.mappers, self.used_features,
+                    self.bundle_plan)):
             self._sparsify_store()
 
         md = metadata or Metadata()
@@ -708,21 +712,49 @@ class Dataset:
         """[C, N] dense binned store.  A sparse dataset materializes it
         LAZILY on first access — counted as tree/sparse_fallbacks so
         silent densification is operator-visible (docs/Sparse.md lists
-        the consumers without a sparse path: feature-sharded learners,
-        binned score replay, binary-cache writes)."""
+        the consumers without a sparse path: bundled feature-sharded
+        feeds, binary-cache writes, C-API subsets).  Consumers that can
+        name themselves call `dense_bins(site=...)` instead, which also
+        bumps the site-labeled series."""
+        return self.dense_bins()
+
+    def dense_bins(self, site: str = "unlabeled") -> np.ndarray:
+        """`bins` with the densifying consumer named: the canonical
+        tree/sparse_fallbacks total stays (alerts key on it), and a
+        site-labeled series (same registry discipline as the serve/*
+        labels) tells operators WHICH consumer densified."""
         if self._bins is None and self.sparse is not None:
             from . import log, profiling
             profiling.count(profiling.SPARSE_FALLBACKS)
+            profiling.count(profiling.labeled(profiling.SPARSE_FALLBACKS,
+                                              site=site))
             log.warning(
                 f"sparse store materialized dense ({self.num_store_columns}"
                 f" x {self.num_data} cells) for a consumer without a "
-                "sparse path")
+                f"sparse path (site={site})")
             self._bins = self.sparse.densify(self._store_dtype)
         return self._bins
 
     @bins.setter
     def bins(self, value) -> None:
         self._bins = value
+
+    def sparse_triple(self):
+        """Device (cols [N, R] int32, binsv [N, R] int32, zero_bin [C]
+        int32) view of the sparse store — the ELL traversal feed for
+        the ScoreUpdater / `predict_ensemble_binned_sparse` consumers
+        (bin per (row, column) answered by probing the row's stored
+        entries, zero bin otherwise).  None for dense datasets."""
+        if self.sparse is None:
+            return None
+        import jax.numpy as jnp
+        sp = self.sparse
+        n = self.num_data
+        return (jnp.asarray(np.ascontiguousarray(sp.cols[:n]),
+                            dtype=jnp.int32),
+                jnp.asarray(np.ascontiguousarray(
+                    sp.bins[:n].astype(np.int32))),
+                jnp.asarray(sp.zero_bin, dtype=jnp.int32))
 
     def _sparsify_store(self) -> None:
         """Convert the freshly-binned dense store to the CSR/ELL sparse
@@ -1112,12 +1144,13 @@ class Dataset:
         from the bundled columns (feature-sharded learners need per-
         feature rows; everything else consumes the bundled store)."""
         if self.bundle_plan is None:
-            return self.bins
+            return self.dense_bins(site="unbundled_bins")
+        store = self.dense_bins(site="unbundled_bins")
         plan = self.bundle_plan
         F = len(self.used_features)
-        out = np.empty((F, self.num_data), self.bins.dtype)
+        out = np.empty((F, self.num_data), store.dtype)
         for k in range(F):
-            col = self.bins[int(plan.feat_col[k])]
+            col = store[int(plan.feat_col[k])]
             if not plan.feat_packed[k]:
                 out[k] = col
                 continue
@@ -1126,8 +1159,74 @@ class Dataset:
             s = col.astype(np.int32) - off
             in_r = (s >= 0) & (s < int(plan.feat_nslots[k]))
             orig = np.where(in_r, s + (s >= d), d)
-            out[k] = orig.astype(self.bins.dtype)
+            out[k] = orig.astype(store.dtype)
         return out
+
+    def sparse_entries(self):
+        """Host COO view of the sparse store — (rows int64, cols int32,
+        binv int32, zero_bin int32) over exactly the stored cells in
+        row-major entry order.  None for dense datasets.  Streaming
+        capacity rows past num_data are sliced off, matching
+        sparse_triple."""
+        if self.sparse is None:
+            return None
+        sp = self.sparse
+        n = self.num_data
+        ri, sj = np.nonzero(sp.cols[:n] < sp.num_columns)
+        return (ri.astype(np.int64), sp.cols[ri, sj].astype(np.int32),
+                sp.bins[ri, sj].astype(np.int32),
+                sp.zero_bin.astype(np.int32))
+
+    def unbundled_sparse_entries(self):
+        """COO entries of `unbundled_bins()` WITHOUT densifying — the
+        feature-sharded / voting learners' sparse feed under EFB.
+
+        Each stored (row, store column, bin) entry decodes to at most
+        ONE (row, original feature, original bin) nonzero: the bundle's
+        slot windows are disjoint, and an in-window slot value never
+        decodes to its member's default bin (s < d -> orig = s != d;
+        s >= d -> orig = s + 1 > d — the same decode as unbundled_bins,
+        which maps out-of-window values to the default).  Singleton
+        columns copy through (stored bins differ from the column zero
+        bin, which IS the feature default).  Conflict-remainder entries
+        outside every member's window decode to all-defaults and drop.
+
+        Returns (rows int64, feats int32, binv int32, zero_bin_f int32)
+        with entries in row-major order and zero_bin_f the per-ORIGINAL-
+        feature default bins."""
+        ent = self.sparse_entries()
+        if ent is None:
+            raise ValueError("unbundled_sparse_entries needs a sparse store")
+        ri, ci, bi, _ = ent
+        zb_f = store_zero_bins(self.mappers, self.used_features, None)
+        plan = self.bundle_plan
+        if plan is None:
+            return ri, ci, bi, zb_f
+        order = np.argsort(ci, kind="stable")
+        ri, ci, bi = ri[order], ci[order], bi[order]
+        out_r, out_f, out_b = [], [], []
+        for k in range(len(self.used_features)):
+            col = int(plan.feat_col[k])
+            lo = np.searchsorted(ci, col, side="left")
+            hi = np.searchsorted(ci, col, side="right")
+            if lo == hi:
+                continue
+            rk, bk = ri[lo:hi], bi[lo:hi]
+            if plan.feat_packed[k]:
+                s = bk - int(plan.feat_offset[k])
+                m = (s >= 0) & (s < int(plan.feat_nslots[k]))
+                rk, s = rk[m], s[m]
+                bk = s + (s >= int(plan.feat_default[k]))
+            out_r.append(rk)
+            out_f.append(np.full(rk.size, k, np.int32))
+            out_b.append(bk.astype(np.int32))
+        if not out_r:
+            z = np.zeros(0, np.int64)
+            return z, z.astype(np.int32), z.astype(np.int32), zb_f
+        rows = np.concatenate(out_r)
+        order = np.argsort(rows, kind="stable")
+        return (rows[order], np.concatenate(out_f)[order],
+                np.concatenate(out_b)[order], zb_f)
 
     def realized_conflict_rate(self) -> float:
         if self.bundle_plan is None or self.num_data == 0:
@@ -1174,9 +1273,9 @@ class Dataset:
         (bin 0, weight 0) so padded gathers need no branches."""
         if self._device_bins is None:
             import jax.numpy as jnp
+            store = self.dense_bins(site="device_bins")
             padded = np.concatenate(
-                [self.bins,
-                 np.zeros((self.bins.shape[0], 1), self.bins.dtype)],
+                [store, np.zeros((store.shape[0], 1), store.dtype)],
                 axis=1)
             self._device_bins = jnp.asarray(padded.astype(np.int8 if
                 padded.dtype == np.uint8 else np.int16))
@@ -1201,10 +1300,11 @@ class Dataset:
         the same rows would write — instead of freezing one run's
         capacity tier into the file."""
         md = self.metadata
+        store = self.dense_bins(site="binary_cache")
         arrays = {
-            "bins": (self.bins if self.bins.shape[1] == self.num_data
+            "bins": (store if store.shape[1] == self.num_data
                      else np.ascontiguousarray(
-                         self.bins[:, : self.num_data])),
+                         store[:, : self.num_data])),
             "num_data": np.int64(self.num_data),
             "num_total_features": np.int64(self.num_total_features),
             "used_features": np.asarray(self.used_features, np.int64),

@@ -37,7 +37,7 @@ def sentinel_bins_t(dataset) -> np.ndarray:
     """[N+1, C] int32 transpose of the STORE (per-feature rows, or EFB
     bundle columns) with a sentinel row at index N (bin 0) so padded
     gathers are branch-free."""
-    bins_np = dataset.bins.astype(np.int32)
+    bins_np = dataset.dense_bins(site="bins_t").astype(np.int32)
     pad = np.zeros((bins_np.shape[0], 1), np.int32)
     return np.concatenate([bins_np, pad], axis=1).T.copy()
 

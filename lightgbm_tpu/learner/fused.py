@@ -35,14 +35,15 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import Config
-from ..dataset import Dataset
+from ..dataset import Dataset, nnz_capacity_tier
 from ..sharded.mesh import (check_scatter_divisible, check_tree_divergence,
                             make_mesh, mesh_axes, pad_cols_to_ndev,
                             resolve_hist_exchange)
 from .common import (make_split_kw, padded_bin_count, sentinel_bins_t,
                      use_parent_hist_cache)
 from ..jaxutil import bag_mask_dev, pad_rows_dev, slice_rows_dev
-from ..ops.histogram import histogram_full_masked
+from ..ops.histogram import histogram_full_masked, histogram_full_sparse
+from ..ops.predict import sparse_bin_lookup
 from ..ops.split import (best_split, bundle_predicate_params,
                          combine_sharded_records, identity_feat_table,
                          leaf_output, maybe_unbundle, sharded_slice_search,
@@ -91,7 +92,12 @@ def build_tree(bins, grad, hess, row_mask, num_bins, is_cat, fmask, ftbl,
     both axes are None).
 
     bins     : [Floc, Nloc] int  — this shard's STORE columns (= original
-               per-feature bins, or bundled columns under EFB)
+               per-feature bins, or bundled columns under EFB); OR a
+               sparse ELL triple (cols [1, Nloc, R], binsv [1, Nloc, R],
+               zero_bin [1, Floc]) — the shard's column window of the
+               sparse store with a leading feature-shard axis that is 1
+               per shard_map block (and kept at 1 on the unsharded path
+               so both squeeze uniformly)
     grad/hess/row_mask : [Nloc] f32 (row_mask is 0 for padding / out-of-bag)
     num_bins/is_cat/fmask : per-ORIGINAL-feature metadata for this shard
     ftbl     : [5, F] feature→(col, offset, default, nslots, packed) table
@@ -101,7 +107,13 @@ def build_tree(bins, grad, hess, row_mask, num_bins, is_cat, fmask, ftbl,
                histogram is unbundled before split search
     Returns (TreeArrays, leaf_id [Nloc] int32).
     """
-    Floc, Nloc = bins.shape
+    sparse = isinstance(bins, (tuple, list))
+    if sparse:
+        sp_cols, sp_bins, sp_zb = bins[0][0], bins[1][0], bins[2][0]
+        Floc = sp_zb.shape[0]
+        Nloc = sp_cols.shape[0]
+    else:
+        Floc, Nloc = bins.shape
     L = num_leaves
     B = num_bins_padded
     skw = dict(split_kw)
@@ -128,6 +140,12 @@ def build_tree(bins, grad, hess, row_mask, num_bins, is_cat, fmask, ftbl,
     Fs = Floc // nd if hx else Floc
 
     def make_local_hist(mask):
+        if sparse:
+            return histogram_full_sparse(sp_cols, sp_bins, sp_zb,
+                                         grad, hess, mask,
+                                         num_columns_padded=Floc,
+                                         num_bins_padded=B,
+                                         input_dtype=input_dtype)
         return histogram_full_masked(bins, grad, hess, mask,
                                      num_bins_padded=B,
                                      input_dtype=input_dtype)
@@ -255,8 +273,12 @@ def build_tree(bins, grad, hess, row_mask, num_bins, is_cat, fmask, ftbl,
         col, T, lo, hi1, dl = bundle_predicate_params(ftbl, feat, thr, catf)
         lf = col - f_off
         owned = (lf >= 0) & (lf < Floc)
-        featrow = jnp.take(bins, jnp.clip(lf, 0, Floc - 1),
-                           axis=0).astype(jnp.int32)
+        lc = jnp.clip(lf, 0, Floc - 1)
+        if sparse:
+            featrow = sparse_bin_lookup(sp_cols, sp_bins, sp_zb,
+                                        jnp.broadcast_to(lc, (Nloc,)))
+        else:
+            featrow = jnp.take(bins, lc, axis=0).astype(jnp.int32)
         gl = store_go_left(featrow, T, lo, hi1, dl, catf)
         gl = jnp.where(owned, gl, False)
         if feature_axis is not None:
@@ -549,7 +571,7 @@ class FusedTreeLearner:
         # the per-pass payload (the voted subset for PV-Tree), then size
         # the store so the histogram's column axis tiles the data axis
         # under psum_scatter
-        pay_cols = (dataset.bins.shape[0] if self.use_bundle
+        pay_cols = (dataset.num_store_columns if self.use_bundle
                     else max(1, self.Fp // self.df))
         if voting:
             pay_cols = max(1, min(2 * int(cfg.top_k), self.F))
@@ -561,27 +583,38 @@ class FusedTreeLearner:
             # each feature shard's Fp/df column slice must itself tile
             # the data axis, so the unit is the full df*dd product
             self.Fp = pad_cols_to_ndev(self.F, self.df * self.dd)
+        # sparse datasets feed the fused builders directly (per-shard ELL
+        # windows of the store — no densification); the multi-process
+        # row exchange still ships dense blocks, so mh keeps the counted
+        # dense fallback (ROADMAP: multi-host sparse ingest)
+        self._sparse_feed = dataset.sparse is not None and self.mh is None
+        bins_np = None
         if self.use_bundle:
-            store = dataset.bins
-            bins_np = store.astype(np.int32)
-            if self._local_np > self.N:
-                bins_np = np.pad(bins_np,
-                                 ((0, 0), (0, self._local_np - self.N)))
-            self.Cstore = store.shape[0]
+            self.Cstore = dataset.num_store_columns
+            cp = 0
             if hx_pad and self.Cstore % self.dd:
                 # trivial zero columns so the bundled store tiles the
                 # data axis (the unbundle sentinel must sit past them)
                 cp = pad_cols_to_ndev(self.Cstore, self.dd) - self.Cstore
-                bins_np = np.pad(bins_np, ((0, cp), (0, 0)))
                 self.Cstore += cp
+            if not self._sparse_feed:
+                store = dataset.dense_bins(site="fused_feed")
+                bins_np = store.astype(np.int32)
+                if self._local_np > self.N:
+                    bins_np = np.pad(bins_np,
+                                     ((0, 0), (0, self._local_np - self.N)))
+                if cp:
+                    bins_np = np.pad(bins_np, ((0, cp), (0, 0)))
         else:
-            base = (dataset.bins if plan is None
-                    else dataset.unbundled_bins())
-            bins_np = base.astype(np.int32)
-            if self.Fp > self.F or self._local_np > self.N:
-                bins_np = np.pad(bins_np, ((0, self.Fp - self.F),
-                                           (0, self._local_np - self.N)))
             self.Cstore = self.Fp
+            if not self._sparse_feed:
+                base = (dataset.dense_bins(site="fused_feed")
+                        if plan is None else dataset.unbundled_bins())
+                bins_np = base.astype(np.int32)
+                if self.Fp > self.F or self._local_np > self.N:
+                    bins_np = np.pad(bins_np,
+                                     ((0, self.Fp - self.F),
+                                      (0, self._local_np - self.N)))
         nb = np.pad(dataset.num_bins.astype(np.int32),
                     (0, self.Fp - self.F), constant_values=1)
         ic = np.pad(dataset.is_categorical, (0, self.Fp - self.F))
@@ -618,10 +651,15 @@ class FusedTreeLearner:
                   hist_exchange=self.hist_exchange,
                   cache_parent_hist=self.cache_parent_hist,
                   input_dtype=getattr(cfg, "histogram_dtype", "float32"))
+        sp_feed = self._assemble_sparse_feed() if self._sparse_feed \
+            else None
         if mesh is None:
             fn = functools.partial(build_tree, ftbl=ftbl, unb=unb, **kw)
             self._build = jax.jit(fn)
-            self.bins_dev = jnp.asarray(bins_np)
+            if sp_feed is not None:
+                self.bins_dev = tuple(jnp.asarray(x) for x in sp_feed)
+            else:
+                self.bins_dev = jnp.asarray(bins_np)
         else:
             from jax.sharding import PartitionSpec as P, NamedSharding
             fn = functools.partial(
@@ -631,7 +669,9 @@ class FusedTreeLearner:
                 feature_shard_size=self.Fp // self.df)
             da = "data" if self.dd > 1 else None
             fa = "feature" if self.df > 1 else None
-            in_specs = (P(fa, da), P(da), P(da), P(da), P(fa), P(fa), P(fa))
+            bins_spec = ((P(fa, da, None), P(fa, da, None), P(fa, None))
+                         if sp_feed is not None else P(fa, da))
+            in_specs = (bins_spec, P(da), P(da), P(da), P(fa), P(fa), P(fa))
             out_specs = (jax.tree_util.tree_map(lambda _: P(), TreeArrays(
                 *[0] * len(TreeArrays._fields))), P(da))
             from ..sharded.mesh import compat_shard_map
@@ -640,6 +680,14 @@ class FusedTreeLearner:
                 check_vma=False))
             if self.mh is not None:
                 self.bins_dev = self.mh.put_rows(bins_np, P(fa, da))
+            elif sp_feed is not None:
+                self.bins_dev = (
+                    jax.device_put(jnp.asarray(sp_feed[0]),
+                                   NamedSharding(mesh, P(fa, da, None))),
+                    jax.device_put(jnp.asarray(sp_feed[1]),
+                                   NamedSharding(mesh, P(fa, da, None))),
+                    jax.device_put(jnp.asarray(sp_feed[2]),
+                                   NamedSharding(mesh, P(fa, None))))
             else:
                 self.bins_dev = jax.device_put(
                     jnp.asarray(bins_np), NamedSharding(mesh, P(fa, da)))
@@ -649,12 +697,55 @@ class FusedTreeLearner:
         self.num_bins_dev = nb if self.mh is not None else jnp.asarray(nb)
         self.is_cat_dev = ic if self.mh is not None else jnp.asarray(ic)
 
+    def _assemble_sparse_feed(self):
+        """Host [df, Np, R] ELL column windows of the sparse store plus
+        the [df, Fsh] zero-bin rows — the fused builders' sparse feed.
+        Shard j holds its window's entries in LOCAL column ids with
+        sentinel Fsh (= the shard's num_columns_padded); padded columns
+        carry zero_bin -1.  The leading feature axis stays 1 when
+        unsharded so build_tree squeezes both paths uniformly.  Rows are
+        padded to the data tile with no entries — every column reads
+        its zero bin there, and the zero row_mask keeps padding out of
+        the histograms either way."""
+        ds = self.dataset
+        if self.use_bundle:
+            ri, ci, bi, zb = ds.sparse_entries()
+            ncols = self.Cstore
+        else:
+            ri, ci, bi, zb = ds.unbundled_sparse_entries()
+            ncols = self.Fp
+        zb = np.pad(zb, (0, ncols - zb.size), constant_values=-1)
+        df = self.df
+        Fsh = ncols // df
+        Np = self._local_np
+        w = ci // Fsh
+        key = w.astype(np.int64) * Np + ri
+        cnt = np.bincount(key, minlength=df * Np) if key.size else \
+            np.zeros(df * Np, np.int64)
+        R = nnz_capacity_tier(int(cnt.max(initial=1)))
+        cols_np = np.full((df, Np, R), Fsh, np.int32)
+        ell_np = np.zeros((df, Np, R), np.int32)
+        if key.size:
+            order = np.argsort(key, kind="stable")
+            ks = key[order]
+            offs = np.concatenate([[0], np.cumsum(cnt)])
+            pos = np.arange(ks.size, dtype=np.int64) - offs[ks]
+            cols_np[ks // Np, ks % Np, pos] = (ci - w * Fsh)[order]
+            ell_np[ks // Np, ks % Np, pos] = bi[order]
+        return cols_np, ell_np, zb.reshape(df, Fsh).astype(np.int32)
+
     @property
-    def bins_t(self) -> jax.Array:
-        """[N+1, F] sentinel-padded transpose for the ScoreUpdater's binned
-        tree traversal (same layout as SerialTreeLearner.bins_t)."""
+    def bins_t(self):
+        """Store view for the ScoreUpdater's binned tree traversal:
+        [N+1, F] sentinel-padded transpose (same layout as
+        SerialTreeLearner.bins_t), or the sparse ELL triple when the
+        dataset is sparse — replay then probes the row segments and the
+        store never densifies for scoring."""
         if getattr(self, "_bins_t", None) is None:
-            self._bins_t = jnp.asarray(sentinel_bins_t(self.dataset))
+            if self.dataset.sparse is not None:
+                self._bins_t = self.dataset.sparse_triple()
+            else:
+                self._bins_t = jnp.asarray(sentinel_bins_t(self.dataset))
         return self._bins_t
 
     def _feature_mask(self):
@@ -768,11 +859,13 @@ def create_tree_learner(dataset: Dataset, config: Config):
     if getattr(dataset, "sparse", None) is not None and growth0 == "auto" \
             and growth != "rounds" and lt not in ("feature", "voting"):
         # the nonzero-iterating kernels live in the rounds learner; an
-        # exact-growth build over a sparse store would densify it, so
-        # `auto` resolves rounds wherever the store is sparse.  An
-        # EXPLICITLY pinned exact growth (and the feature-sharded /
-        # voting learners, which need per-feature store rows) takes the
-        # counted dense fallback instead.
+        # exact-growth build over a sparse store on the host-loop serial
+        # learner would densify it, so `auto` resolves rounds wherever
+        # the store is sparse.  The fused feature-sharded / voting
+        # learners consume per-shard ELL windows directly
+        # (FusedTreeLearner._assemble_sparse_feed) and keep the fused
+        # builder; an EXPLICITLY pinned exact growth takes the counted
+        # dense fallback instead.
         from .. import log
         log.info("sparse store: tree_growth=auto resolves to rounds "
                  "(the nonzero-iterating histogram path)")

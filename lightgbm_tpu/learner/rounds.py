@@ -828,7 +828,8 @@ class RoundsTreeLearner:
             self.Cstore = dataset.sparse.num_columns
             self.Fpad = self.Cstore
         else:
-            store = dataset.bins                     # [C, N] (bundled: C<F)
+            store = dataset.dense_bins(
+                site="rounds_feed")                  # [C, N] (bundled: C<F)
             self.Cstore = store.shape[0]
             if backend == "pallas" and dataset.max_num_bin <= 256 \
                     and self._want_int8_bins():
@@ -1061,9 +1062,16 @@ class RoundsTreeLearner:
         return int32_bytes > 0.25 * limit
 
     @property
-    def bins_t(self) -> jax.Array:
+    def bins_t(self):
+        """Store view for the ScoreUpdater's binned traversal: the
+        sparse ELL triple when the dataset is sparse (the training-set
+        replay probes row segments, zero densification), else the
+        [N+1, C] sentinel-padded dense transpose."""
         if getattr(self, "_bins_t", None) is None:
-            self._bins_t = jnp.asarray(sentinel_bins_t(self.dataset))
+            if self.dataset.sparse is not None:
+                self._bins_t = self.dataset.sparse_triple()
+            else:
+                self._bins_t = jnp.asarray(sentinel_bins_t(self.dataset))
         return self._bins_t
 
     def _feature_mask(self):

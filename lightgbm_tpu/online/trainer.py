@@ -146,6 +146,17 @@ class OnlineTrainer:
         # has span tracing on
         self._window_traces: set = set()
         self._WINDOW_TRACES_CAP = 1024
+        # adaptive bin budgets (bin_budget > 0): each window's raw rows
+        # ride in a ring so the post-refresh drift check can recompute
+        # the per-feature allocation and refreeze the mappers when the
+        # traffic distribution has moved (docs/Online-Learning.md "Adaptive bin
+        # budgets"); the baseline allocation re-derives from the first
+        # window after every (re)start
+        self._rebudget = int(getattr(cfg, "bin_budget", 0) or 0) > 0
+        self._raw_ring: List[Tuple[np.ndarray, np.ndarray,
+                                   Optional[np.ndarray]]] = []
+        self._raw_rows = 0
+        self._budget_alloc: Optional[np.ndarray] = None
         if reference is not None:
             self._window = RawDataset.streaming_from(
                 reference, cfg, capacity=self.trigger)
@@ -380,6 +391,15 @@ class OnlineTrainer:
     def _ingest(self, X: np.ndarray, y: np.ndarray,
                 w: Optional[np.ndarray]) -> None:
         self.rows_seen += len(X)
+        if self._rebudget:
+            # raw-row ring for the adaptive-budget drift check; capped
+            # at 4 windows so a poll backlog cannot grow it unbounded
+            self._raw_ring.append((X, y, w))
+            self._raw_rows += len(X)
+            while (len(self._raw_ring) > 1
+                   and self._raw_rows - len(self._raw_ring[0][0])
+                   >= 4 * self.trigger):
+                self._raw_rows -= len(self._raw_ring.pop(0)[0])
         if self._window is not None:
             self._window.append_rows(X, y, w)
             if self.mode == "refit":
@@ -487,12 +507,82 @@ class OnlineTrainer:
             stats["refresh_seconds"] = round(time.perf_counter() - t0, 4)
             self._publish(stats)
         window.reset_rows()
+        self._maybe_rebudget()
         self._leaf_chunks = []
         self._window_traces = set()
         self._published_offset = int(self.traffic.offset)
         self._record_refresh(ok=True, rows=stats.get("rows", 0))
         self._flush_state()
         return True
+
+    def _window_budget_alloc(self) -> Optional[np.ndarray]:
+        """Per-raw-feature adaptive bin allocation over the ring's raw
+        rows — the same distinct/mass rule find_bin_mappers applies
+        under ``bin_budget`` (binning.allocate_bin_budgets), so two
+        windows from the same distribution produce the same vector and
+        drift is measured allocation-vs-allocation, not against the
+        mappers' realized bin counts (which find_bin may leave under
+        budget on low-cardinality features)."""
+        if not self._raw_ring:
+            return None
+        from ..binning import allocate_bin_budgets
+        X = np.concatenate([c[0] for c in self._raw_ring])
+        d = np.empty(X.shape[1], np.int64)
+        m = np.empty(X.shape[1], np.int64)
+        for j in range(X.shape[1]):
+            col = X[:, j]
+            nz = col[(col != 0.0) & ~np.isnan(col)]
+            d[j] = np.unique(nz).size + 1     # + the implied zero
+            m[j] = nz.size
+        return allocate_bin_budgets(d, m, int(self.cfg.bin_budget))
+
+    def _maybe_rebudget(self) -> None:
+        """Adaptive bin budgets under drift (``bin_budget > 0``): after
+        each refresh, recompute the per-feature allocation over the
+        window just consumed; when it drifts from the baseline
+        allocation by more than LIGHTGBM_TPU_ONLINE_REBUDGET_DRIFT
+        (L1 share, default 0.25), refreeze the mappers from the ring's
+        raw rows through the existing refbin handshake — the sidecar
+        sha1 updates, the next publish meta carries it, and
+        serve_quantize=auto re-resolves binned vs raw against the new
+        boundaries (a registry serving the old generation keeps its old
+        refbin until the hot-swap)."""
+        if not self._rebudget:
+            return
+        want = self._window_budget_alloc()
+        if want is None:
+            return
+        base = self._budget_alloc
+        if base is None or want.size != base.size:
+            self._budget_alloc = want
+            self._raw_ring, self._raw_rows = [], 0
+            return
+        drift = (float(np.abs(want.astype(np.int64)
+                              - base.astype(np.int64)).sum())
+                 / max(int(base.sum()), 1))
+        thresh = float(os.environ.get(
+            "LIGHTGBM_TPU_ONLINE_REBUDGET_DRIFT", "0.25"))
+        if drift > thresh:
+            X = np.concatenate([c[0] for c in self._raw_ring])
+            y = np.concatenate([c[1] for c in self._raw_ring])
+            try:
+                newref = RawDataset(X, y, config=self.cfg)
+                self._window = RawDataset.streaming_from(
+                    newref, self.cfg, capacity=self.trigger)
+                self._save_refbin(newref)
+                self._refitter = None     # window dataset changed
+                self._budget_alloc = want
+                log.info(
+                    f"online: bin-budget drift {drift:.3f} > {thresh:g}"
+                    f" — refroze adaptive mappers from the last "
+                    f"{len(X)}-row window (refbin "
+                    f"{str(self._mapper_fp)[:12]})")
+            except Exception as e:
+                log.warning(
+                    f"online: bin-budget refreeze failed "
+                    f"({type(e).__name__}: {e}); keeping the frozen "
+                    "mappers")
+        self._raw_ring, self._raw_rows = [], 0
 
     def _record_refresh(self, ok: bool, rows: int = 0,
                         error: Optional[str] = None) -> None:

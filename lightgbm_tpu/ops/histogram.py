@@ -161,9 +161,10 @@ def disable_narrow_onehot():
 
 def _coerce_dtype(input_dtype: str) -> str:
     """int8 means caller-side gradient quantization, which only the
-    rounds learner's masked kernel implements; a bare int8 cast would
-    TRUNCATE real-valued grads, so every other kernel runs f32 and says
-    so (the warning fires once per compile, at trace time)."""
+    rounds learner's kernels implement (the dense masked kernel and the
+    sparse XLA/pallas pair); a bare int8 cast would TRUNCATE real-valued
+    grads, so every other kernel runs f32 and says so (the warning fires
+    once per compile, at trace time)."""
     if input_dtype == "int8":
         from .. import log
         # graftlint: allow(retrace-hazard) — deliberate ONE-shot warning at trace time (static branch, never re-fires per iteration)
@@ -955,8 +956,10 @@ def _slot_of_rows(lid: jax.Array, sl: jax.Array) -> jax.Array:
 
 def _slot_totals(srow: jax.Array, gh8: jax.Array, K: int) -> jax.Array:
     """[K, 3] per-slot (sum_grad, sum_hess, count) — the zero-bin
-    reconstruction anchor, accumulated over ALL rows of each slot."""
-    tot = jnp.zeros((K + 1, 3), jnp.float32)
+    reconstruction anchor, accumulated over ALL rows of each slot.
+    Dtype follows gh8: f32 for real-valued grads, int32 for the
+    quantized lanes (where the residual must stay an exact integer)."""
+    tot = jnp.zeros((K + 1, 3), gh8.dtype)
     return tot.at[srow].add(gh8[:3].T)[:K]
 
 
@@ -968,10 +971,12 @@ def _apply_zero_bin(hist: jax.Array, tot: jax.Array,
     zero_bin [C] (-1 marks padded columns, which must stay all-zero).
     Exact for counts (integers < 2^24) and within one f32 rounding of
     the dense accumulation for grad/hess — the same property the dense
-    paths accept from parent-histogram subtraction."""
+    paths accept from parent-histogram subtraction.  In the int32
+    quantized lanes the subtraction is exact, period."""
     colsum = jnp.sum(hist, axis=3)                       # [K, C, 3]
-    valid = (zero_bin >= 0).astype(jnp.float32)
-    resid = (tot[:, None, :] - colsum) * valid[None, :, None]
+    resid = jnp.where((zero_bin >= 0)[None, :, None],
+                      tot[:, None, :] - colsum,
+                      jnp.zeros_like(colsum))
     zb = jnp.clip(zero_bin, 0, hist.shape[3] - 1)
     C = hist.shape[1]
     # advanced-index add: the (arange, zb) pair broadcasts to [C], and
@@ -980,12 +985,31 @@ def _apply_zero_bin(hist: jax.Array, tot: jax.Array,
     return hist.at[:, jnp.arange(C), :, zb].add(resid.transpose(1, 0, 2))
 
 
+def _sparse_quant_ok(input_dtype: str, num_rows: int) -> bool:
+    """Trace-time int8 eligibility for the sparse kernels: the same
+    int32-exactness bound the dense masked kernel enforces (127·rows
+    < 2^31 and per-cell counts < 2^24), keyed on the ROW count — every
+    (column, bin) cell accumulates at most one entry per row."""
+    if input_dtype != "int8":
+        return False
+    if num_rows > 16_000_000:
+        from .. import log
+        # graftlint: allow(retrace-hazard) — deliberate ONE-shot warning at trace time (shape is static, fires once per compile)
+        log.warning("histogram_dtype=int8 disabled for this sparse pass: "
+                    f"{num_rows} rows exceeds the int32-exactness bound "
+                    "(16M rows per device); using float32")
+        return False
+    return True
+
+
 @functools.partial(jax.jit, static_argnames=("num_columns_padded",
-                                             "num_bins_padded"))
+                                             "num_bins_padded",
+                                             "input_dtype"))
 def hist_sparse_xla(cols: jax.Array, binsv: jax.Array, zero_bin: jax.Array,
                     lid: jax.Array, gh8: jax.Array, sl: jax.Array, *,
                     num_columns_padded: int,
-                    num_bins_padded: int) -> jax.Array:
+                    num_bins_padded: int,
+                    input_dtype: str = "float32") -> jax.Array:
     """Nonzero-iterating multi-leaf histogram, XLA scatter-add path.
 
     cols/binsv : [N, R] ELL entries (col >= num_columns_padded marks an
@@ -994,24 +1018,42 @@ def hist_sparse_xla(cols: jax.Array, binsv: jax.Array, zero_bin: jax.Array,
     sl [K] int32 leaf ids to histogram (-1 = empty slot).
     Returns [K, Cp, 3, B] f32 — hist_multileaf_masked's contract over
     the sparse store.
+
+    input_dtype "int8" selects per-pass symmetric gradient quantization
+    (_quantize_gh — the dense masked kernel's discipline) with the whole
+    accumulation held in INTEGER lanes: int32 scatter-add of the
+    quantized entries, int32 slot totals, int32 zero-bin residual, ONE
+    dequantizing scale at the end.  That makes the XLA path
+    bitwise-identical to the pallas sparse int8 kernel for any
+    gradients (both are exact integer sums of the same addends), and
+    keeps `totals − Σstored` exact in the integer domain.
     """
     N, R = cols.shape
     K = sl.shape[0]
     Cp, B = num_columns_padded, num_bins_padded
+    quant = _sparse_quant_ok(input_dtype, N)
+    if quant:
+        gh_acc, sg, sh = _quantize_gh(gh8)               # [8, N] int32
+    else:
+        gh_acc = gh8
     srow = _slot_of_rows(lid, sl)                        # [N]
-    tot = _slot_totals(srow, gh8, K)
+    tot = _slot_totals(srow, gh_acc, K)
     valid_e = cols < Cp                                  # [N, R]
     # entries of unslotted rows and empty ELL slots both route to the
     # K scratch slot (sliced off); column/bin ids stay in range
     s_e = jnp.where(valid_e, srow[:, None], K).reshape(-1)
     c_e = jnp.minimum(cols, Cp - 1).reshape(-1)
     b_e = jnp.minimum(binsv, B - 1).reshape(-1)
-    v3 = jnp.stack([gh8[0], gh8[1], gh8[2]], axis=1)     # [N, 3]
+    v3 = jnp.stack([gh_acc[0], gh_acc[1], gh_acc[2]], axis=1)   # [N, 3]
     v_e = jnp.broadcast_to(v3[:, None, :], (N, R, 3)).reshape(-1, 3)
-    hist = jnp.zeros((K + 1, Cp, B, 3), jnp.float32)
+    hist = jnp.zeros((K + 1, Cp, B, 3), gh_acc.dtype)
     hist = hist.at[s_e, c_e, b_e].add(v_e)[:K]           # [K, Cp, B, 3]
     hist = hist.transpose(0, 1, 3, 2)                    # [K, Cp, 3, B]
-    return _apply_zero_bin(hist, tot, zero_bin)
+    hist = _apply_zero_bin(hist, tot, zero_bin)
+    if quant:
+        scale = jnp.stack([sg, sh, jnp.float32(1.0)])
+        hist = hist.astype(jnp.float32) * scale[None, None, :, None]
+    return hist
 
 
 def sparse_window_streams(cols: np.ndarray, binsv: np.ndarray,
@@ -1135,6 +1177,47 @@ def _hist_kernel_sparse(sl_ref, fb_ref, lid_ref, gh_ref, out_ref, *,
                                 precision=prec)
 
 
+def _hist_kernel_sparse_q(sl_ref, fb_ref, lid_ref, gh_ref, out_ref, *,
+                          WB: int, K: int):
+    """Quantized variant of _hist_kernel_sparse: gh_ref carries
+    int8-ranged int32 quantized entries, the MXU contraction runs
+    int8 x int8 -> int32 and the [1, Mp, WB] output accumulates EXACT
+    int32 partial histograms (dequantized once, outside, after the
+    slot unscatter and integer zero-bin reconstruction).
+
+    As in _hist_kernel_masked_q, elementwise mask work stays in i32
+    (Mosaic has no int8 'arith.muli' on this target) — only the matmul
+    OPERANDS are int8, which is where the throughput lives, and the
+    i32->i8 truncation is a supported cast (values are int8-ranged by
+    construction)."""
+    from jax.experimental import pallas as pl
+
+    k = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    lid = lid_ref[0, :]                                  # [Eblk]
+    sl = sl_ref[:K, 0:1]                                 # [K, 1]
+    m = (lid[None, :] == sl).astype(jnp.int32)           # [K, Eblk]
+    vals32 = jnp.concatenate([m * gh_ref[0, 0:1, :], m * gh_ref[0, 1:2, :],
+                              m * gh_ref[0, 2:3, :]], axis=0)   # [3K, Eblk]
+    Mp = out_ref.shape[1]
+    if Mp > 3 * K:
+        vals32 = jnp.concatenate(
+            [vals32, jnp.zeros((Mp - 3 * K, vals32.shape[1]), jnp.int32)],
+            axis=0)
+    vals = vals32.astype(jnp.int8)
+    fb = fb_ref[0, :]
+    iota = jax.lax.broadcasted_iota(jnp.int32, (1, WB), 1)
+    # flat ids reach W*B = 1024, so the compare runs in int32; only the
+    # RESULT narrows to int8 (0/1 — exact)
+    oh = (fb[:, None] == iota).astype(jnp.int8)          # [Eblk, WB]
+    out_ref[0, :, :] += jnp.dot(vals, oh,
+                                preferred_element_type=jnp.int32)
+
+
 @functools.partial(jax.jit, static_argnames=("num_columns_padded",
                                              "num_bins_padded",
                                              "input_dtype", "interpret"))
@@ -1150,8 +1233,16 @@ def hist_sparse_pallas(e_row: jax.Array, e_flat: jax.Array,
     gathered per entry OUTSIDE the kernel — nnz-sized XLA gathers —
     then the grid runs (windows, entry-chunks) and the per-slot
     partial histograms fold back to columns (unscatter_slot_hist).
-    Returns [K, Cp, 3, B] f32 with the zero bin reconstructed."""
-    input_dtype = _coerce_dtype(input_dtype)
+    Returns [K, Cp, 3, B] f32 with the zero bin reconstructed.
+
+    input_dtype "int8" routes to _hist_kernel_sparse_q: quantized
+    entries ride int8 MXU operands into an exact int32 accumulator, the
+    slot unscatter and zero-bin residual stay integer, and ONE scale
+    dequantizes at the end — bitwise-identical to hist_sparse_xla's
+    int8 branch (same integer addends, exact sums in any order)."""
+    quant = _sparse_quant_ok(input_dtype, lid.shape[0])
+    if not quant:
+        input_dtype = _coerce_dtype(input_dtype)
     from jax.experimental import pallas as pl
 
     nwin, Ew = e_row.shape
@@ -1160,23 +1251,31 @@ def hist_sparse_pallas(e_row: jax.Array, e_flat: jax.Array,
     W = FEATURE_GROUP
     WB = W * B
     Eblk = min(Ew, SPARSE_CHUNK)
+    if quant:
+        gh_src, sg, sh = _quantize_gh(gh8)               # [8, N] int32
+        acc_dt = jnp.int32
+        kern = functools.partial(_hist_kernel_sparse_q, WB=WB, K=K)
+    else:
+        gh_src = gh8
+        acc_dt = jnp.float32
+        kern = functools.partial(_hist_kernel_sparse, WB=WB, K=K,
+                                 input_dtype=jnp.dtype(input_dtype))
     srow = _slot_of_rows(lid, sl)
-    tot = _slot_totals(srow, gh8, K)
+    tot = _slot_totals(srow, gh_src, K)
     lid_e = jnp.take(lid, e_row.reshape(-1)).reshape(nwin, Ew)
-    ghm = (jnp.take(gh8[:3], e_row.reshape(-1), axis=1)
+    ghm = (jnp.take(gh_src[:3], e_row.reshape(-1), axis=1)
            .reshape(3, nwin, Ew).transpose(1, 0, 2))     # [nwin, 3, Ew]
-    ghm = ghm * e_valid[:, None, :]
+    ghm = ghm * e_valid[:, None, :].astype(acc_dt)
     ghm = jnp.concatenate(
-        [ghm, jnp.zeros((nwin, 5, Ew), jnp.float32)], axis=1)
+        [ghm, jnp.zeros((nwin, 5, Ew), acc_dt)], axis=1)
     Mp = 8 * ((3 * K + 7) // 8)
     Kp = 8 * ((K + 7) // 8)
     sl2 = jnp.broadcast_to(jnp.pad(sl, (0, Kp - K),
                                    constant_values=-1)[:, None], (Kp, 128))
-    dt = jnp.dtype(input_dtype)
     grid = (nwin, Ew // Eblk)
     out = pl.pallas_call(
-        functools.partial(_hist_kernel_sparse, WB=WB, K=K, input_dtype=dt),
-        out_shape=jax.ShapeDtypeStruct((nwin, Mp, WB), jnp.float32),
+        kern,
+        out_shape=jax.ShapeDtypeStruct((nwin, Mp, WB), acc_dt),
         grid=grid,
         in_specs=[
             pl.BlockSpec((Kp, 128), lambda w, k: (0, 0)),
@@ -1193,7 +1292,11 @@ def hist_sparse_pallas(e_row: jax.Array, e_flat: jax.Array,
     h = unscatter_slot_hist(h_slots, slot_col, Cp)
     hist = jnp.stack([h[:, :K], h[:, K:2 * K], h[:, 2 * K:3 * K]],
                      axis=2).transpose(1, 0, 2, 3)       # [K, Cp, 3, B]
-    return _apply_zero_bin(hist, tot, zero_bin)
+    hist = _apply_zero_bin(hist, tot, zero_bin)
+    if quant:
+        scale = jnp.stack([sg, sh, jnp.float32(1.0)])
+        hist = hist.astype(jnp.float32) * scale[None, None, :, None]
+    return hist
 
 
 def hist_sparse_multileaf(sp, lid: jax.Array, gh8: jax.Array,
@@ -1215,13 +1318,15 @@ def hist_sparse_multileaf(sp, lid: jax.Array, gh8: jax.Array,
             interpret=interpret)
     return hist_sparse_xla(cols, binsv, zero_bin, lid, gh8, sl,
                            num_columns_padded=num_columns_padded,
-                           num_bins_padded=num_bins_padded)
+                           num_bins_padded=num_bins_padded,
+                           input_dtype=input_dtype)
 
 
 def hist_sparse_gathered(sp, gh8: jax.Array, perm: jax.Array,
                          seg_off: jax.Array, seg_cnt: jax.Array, *,
                          capacity: int, num_columns_padded: int,
-                         num_bins_padded: int):
+                         num_bins_padded: int,
+                         input_dtype: str = "float32"):
     """Gathered (ordered) sparse histogram: compact the K leaf-contiguous
     row segments of the device row partition into the static scratch
     (gather_segments — CSR row segments permute exactly like dense
@@ -1244,7 +1349,8 @@ def hist_sparse_gathered(sp, gh8: jax.Array, perm: jax.Array,
     sl = jax.lax.iota(jnp.int32, K)
     h = hist_sparse_xla(cg, bg, zero_bin, slot, ghg, sl,
                         num_columns_padded=Cp,
-                        num_bins_padded=num_bins_padded)
+                        num_bins_padded=num_bins_padded,
+                        input_dtype=input_dtype)
     nnz = jnp.sum((cg < Cp).astype(jnp.float32))
     return h, nnz
 
@@ -1262,3 +1368,36 @@ def histogram_full_masked(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     vals = jnp.stack([grad * mask, hess * mask, mask])   # [3, N]
     return hist_xla(bins.T.astype(jnp.int32), vals,
                     num_bins_padded=num_bins_padded, input_dtype=input_dtype)
+
+
+def histogram_full_sparse(cols: jax.Array, binsv: jax.Array,
+                          zero_bin: jax.Array, grad: jax.Array,
+                          hess: jax.Array, mask: jax.Array, *,
+                          num_columns_padded: int, num_bins_padded: int,
+                          input_dtype: str = "float32") -> jax.Array:
+    """histogram_full_masked's contract over a per-shard ELL window —
+    the fused (feature-sharded / voting) learners' sparse feed.
+
+    cols/binsv: [N, R] ELL entries in the shard's LOCAL column space
+    (col >= num_columns_padded marks an empty slot); zero_bin [Cp] int32
+    (-1 = padded column); grad/hess [N] f32; mask [N] f32 0/1 row
+    weights.  Returns [Cp, 3, B] f32 — masked rows contribute zero to
+    both the stored entries and the totals, so the zero-bin residual is
+    exact for any mask (the K=1 specialization of hist_sparse_xla).
+
+    int8 coerces like the dense fused feed does (_coerce_dtype): the
+    fused learners' quantized story is the rounds learner's — keeping
+    both feeds f32 preserves the sparse-vs-dense dyadic-bitwise parity
+    contract per learner.
+    """
+    N = grad.shape[0]
+    gh8 = jnp.concatenate(
+        [jnp.stack([grad * mask, hess * mask, mask]),
+         jnp.zeros((5, N), jnp.float32)], axis=0)
+    lid = jnp.zeros((N,), jnp.int32)
+    sl = jnp.zeros((1,), jnp.int32)
+    h = hist_sparse_xla(cols, binsv, zero_bin, lid, gh8, sl,
+                        num_columns_padded=num_columns_padded,
+                        num_bins_padded=num_bins_padded,
+                        input_dtype=_coerce_dtype(input_dtype))
+    return h[0]

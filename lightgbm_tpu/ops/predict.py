@@ -32,6 +32,7 @@ Two kernels implement that walk, selected by ``predict_kernel``:
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple, Optional
 
 import jax
@@ -60,32 +61,49 @@ def resolve_predict_kernel(kernel: str = "auto") -> str:
 # go compute-bound on the walk-all grouped traversal: the per-level
 # record gather over all T_total trees dwarfs the one launch that
 # co-stacking saves, so `auto` switches to the segment-gathered walk.
+# Default for the validated `costack_segment_trees` Config key; direct
+# resolve_costack_kernel callers inherit it when they pass no override.
 COSTACK_SEGMENT_TREES = 4096
 
 
 def resolve_costack_kernel(kernel: str = "auto", *,
-                           total_trees: int = 0) -> str:
+                           total_trees: int = 0,
+                           segment_trees: int = 0) -> str:
     """Resolve the ``costack_kernel`` dial to a concrete grouped
     traversal (config.COSTACK_KERNELS).
 
     ``auto`` picks ``segment`` on compute-bound backends (CPU: node
     math scales with the trees walked, so walking all T_total stacked
     trees costs ~G x a solo tenant per row) and on accelerators once
-    the group's total stacked tree count crosses
-    ``COSTACK_SEGMENT_TREES``; ``stacked`` stays the pick where launch
-    overhead dominates (the TPU premise — surplus trees ride a
-    gather-bound depth loop for free).  Both variants are
-    bitwise-identical to per-tenant dispatch (tests/test_costack.py),
-    so the dial is purely a cost model.
+    the group's total stacked tree count crosses the switch point;
+    ``stacked`` stays the pick where launch overhead dominates (the TPU
+    premise — surplus trees ride a gather-bound depth loop for free).
+    Both variants are bitwise-identical to per-tenant dispatch
+    (tests/test_costack.py), so the dial is purely a cost model.
+
+    ``segment_trees`` (<= 0 = COSTACK_SEGMENT_TREES) is the Config key
+    ``costack_segment_trees``; the LIGHTGBM_TPU_COSTACK_SEGMENT_TREES
+    environment override — read here, at resolve time — wins over both
+    for fleet-wide retunes without a config rollout.
     """
     if kernel not in COSTACK_KERNELS:
         raise ValueError(f"unknown costack_kernel: {kernel!r}; "
                          f"use one of {COSTACK_KERNELS}")
     if kernel != "auto":
         return kernel
+    thresh = int(segment_trees) if segment_trees and segment_trees > 0 \
+        else COSTACK_SEGMENT_TREES
+    env = os.environ.get("LIGHTGBM_TPU_COSTACK_SEGMENT_TREES")
+    if env:
+        try:
+            thresh = max(1, int(env))
+        except ValueError:
+            raise ValueError(
+                "LIGHTGBM_TPU_COSTACK_SEGMENT_TREES must be an integer, "
+                f"got {env!r}")
     if jax.default_backend() not in ("tpu", "gpu"):
         return "segment"
-    return "segment" if total_trees >= COSTACK_SEGMENT_TREES else "stacked"
+    return "segment" if total_trees >= thresh else "stacked"
 
 
 class TreeStack(NamedTuple):
@@ -632,7 +650,26 @@ def predict_ensemble_any(stack, X: jax.Array, *,
     return predict_ensemble(stack, X, meta=meta)
 
 
-def _walk_binned_nodes(stack: EnsembleStack, bins_nt: jax.Array,
+def sparse_bin_lookup(cols: jax.Array, binsv: jax.Array,
+                      zero_bin: jax.Array, col: jax.Array) -> jax.Array:
+    """Store bin id per requested column, straight off the ELL row
+    segments — the traversal-side analog of the sparse partition probe
+    (ops/partition.partition_rows_sparse): a stored (column, bin) entry
+    answers directly, everything else answers the column's zero bin.
+
+    cols/binsv: [N, R] ELL entries (col >= num_columns marks an empty
+    slot — never matches a real request); zero_bin: [C] int32 (-1 only
+    for padded columns no tree names); col: [..., N] int32 requested
+    store columns.  Returns [..., N] int32 bin ids.
+    """
+    hit = cols == col[..., None]                         # [..., N, R]
+    bv = jnp.sum(jnp.where(hit, binsv.astype(jnp.int32), 0), axis=-1)
+    C = zero_bin.shape[0]
+    zb = jnp.maximum(jnp.take(zero_bin, jnp.clip(col, 0, C - 1)), 0)
+    return jnp.where(jnp.any(hit, axis=-1), bv, zb)
+
+
+def _walk_binned_nodes(stack: EnsembleStack, bins_nt,
                        feat_tbl: Optional[jax.Array], meta: EnsembleMeta
                        ) -> jax.Array:
     """The binned ensemble walk itself: parked node per (tree, row) —
@@ -643,27 +680,46 @@ def _walk_binned_nodes(stack: EnsembleStack, bins_nt: jax.Array,
     disagree on a routing decision — the online refit subsystem depends
     on routing rows to exactly the leaves whose values the replay sums,
     and serving depends on integer compares reproducing the raw f32
-    kernel bit-for-bit (lightgbm_tpu/quantize.py)."""
-    N = bins_nt.shape[0]
-    bins_nt = bins_nt.astype(jnp.int32)
+    kernel bit-for-bit (lightgbm_tpu/quantize.py).
+
+    bins_nt may instead be the sparse store triple (cols [N, R],
+    binsv [N, R], zero_bin [C]) — then every per-level bin gather runs
+    `sparse_bin_lookup` over the ELL row segments and the store never
+    densifies; the decision logic (`_binned_decide`, the EFB remap) is
+    byte-for-byte the same code, so the sparse walk cannot diverge from
+    the dense one (tests/test_sparse.py pins the bitwise parity)."""
+    sparse = isinstance(bins_nt, (tuple, list))
+    if sparse:
+        cols, binsv, zero_bin = bins_nt
+        cols = cols.astype(jnp.int32)
+        zero_bin = zero_bin.astype(jnp.int32)
+        N = cols.shape[0]
+    else:
+        N = bins_nt.shape[0]
+        bins_nt = bins_nt.astype(jnp.int32)
     T = stack.nodes.shape[0]
     rows = jnp.arange(N)[None, :]
     node = jnp.broadcast_to(stack.root[:, None], (T, N))
     ft = None if feat_tbl is None else feat_tbl.astype(jnp.int32)
+
+    def bin_at(c):
+        if sparse:
+            return sparse_bin_lookup(cols, binsv, zero_bin, c)
+        return bins_nt[rows, c]
 
     def step(_, node):
         safe = jnp.maximum(node, 0)
         rec = jnp.take_along_axis(stack.nodes, safe[:, :, None], axis=1)
         f = rec[..., 0].astype(jnp.int32)
         if ft is None:
-            bv = bins_nt[rows, f]
+            bv = bin_at(f)
         else:
             col = ft[0, f]
             off = ft[1, f]
             dflt = ft[2, f]
             ns = ft[3, f]
             pk = ft[4, f] > 0
-            bv_store = bins_nt[rows, col]
+            bv_store = bin_at(col)
             s = bv_store - off
             in_r = (s >= 0) & (s < ns)
             orig = jnp.where(in_r, s + (s >= dflt).astype(jnp.int32), dflt)
@@ -690,6 +746,28 @@ def predict_ensemble_binned(stack: EnsembleStack, bins_t: jax.Array,
     the store speaks bundle space.
     """
     node = _walk_binned_nodes(stack, bins_t[: bins_t.shape[0] - 1],
+                              feat_tbl, meta)
+    return _leaf_sums(stack, node, meta.num_class)
+
+
+@functools.partial(jax.jit, static_argnames=("meta",))
+def predict_ensemble_binned_sparse(stack: EnsembleStack, cols: jax.Array,
+                                   binsv: jax.Array, zero_bin: jax.Array,
+                                   feat_tbl: Optional[jax.Array] = None, *,
+                                   meta: EnsembleMeta) -> jax.Array:
+    """Raw per-class scores over the SPARSE binned store — [K, N] f32,
+    without densifying: the score replay for `sparse_store=csr` runs.
+
+    cols/binsv: [N, R] ELL row segments (col >= num_columns = empty
+    slot); zero_bin [C] int32.  Per level the walk probes the row's ELL
+    segment for the split column (`sparse_bin_lookup`) instead of
+    gathering from a dense [N, C] store; the routing decisions are the
+    SAME `_walk_binned_nodes` / `_binned_decide` code as the dense
+    replay, so scores are bitwise `predict_ensemble_binned` over
+    `SparseStore.densify()` on every input.  `feat_tbl` composes: the
+    probe answers store-space bins, the EFB remap runs on top.
+    """
+    node = _walk_binned_nodes(stack, (cols, binsv, zero_bin),
                               feat_tbl, meta)
     return _leaf_sums(stack, node, meta.num_class)
 

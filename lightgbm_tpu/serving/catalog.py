@@ -172,7 +172,8 @@ class ModelCatalog:
                  shadow_max_divergence: float = -1.0,
                  warmup_buckets=(1,),
                  costack: bool = True,
-                 costack_kernel: str = "auto"):
+                 costack_kernel: str = "auto",
+                 costack_segment_trees: int = 0):
         if not models:
             raise LightGBMError("ModelCatalog needs at least one "
                                 "model id=path entry")
@@ -197,6 +198,7 @@ class ModelCatalog:
         self._warmup_buckets = tuple(warmup_buckets)
         self._costack = bool(costack)
         self._costack_kernel = str(costack_kernel)
+        self._costack_segment_trees = int(costack_segment_trees or 0)
         solo_forced: Dict[str, bool] = {}
         caps: Dict[str, int] = {}
         for mid, (path, ov) in entries.items():
@@ -264,6 +266,7 @@ class ModelCatalog:
         self._groups: Dict[str, _Group] = {}
         self._costack = False                # overridden by __init__;
         self._costack_kernel = "auto"        # shim defaults otherwise
+        self._costack_segment_trees = 0
         self._costack_opt_out: set = set()
         self._replica_ov: Dict[str, int] = {}
 
@@ -313,7 +316,8 @@ class ModelCatalog:
             [registries[mid].current() for mid in member_ids],
             group_id=gid, replicas=self._group_replicas(member_ids),
             failure_threshold=self._failure_threshold,
-            costack_kernel=self._costack_kernel)
+            costack_kernel=self._costack_kernel,
+            costack_segment_trees=self._costack_segment_trees)
         runtime.warmup(self._warmup_buckets, OUTPUT_KINDS)
         group = _Group(gid, key, member_ids, registries, runtime)
         group.batcher = MicroBatcher(
@@ -561,7 +565,8 @@ class ModelCatalog:
             group_id=group.group_id, generation=old.generation + 1,
             replicas=self._group_replicas(stay),
             failure_threshold=self._failure_threshold,
-            costack_kernel=self._costack_kernel)
+            costack_kernel=self._costack_kernel,
+            costack_segment_trees=self._costack_segment_trees)
         if not runtime.adopt_cache_from(old):
             # program changed (tree shapes, transforms, membership):
             # warm every bucket/kind the outgoing group served before

@@ -548,7 +548,8 @@ def server_from_config(cfg: Config) -> PredictionServer:
         shadow_requests=cfg.serve_shadow_requests,
         shadow_max_divergence=cfg.serve_shadow_max_divergence,
         costack=cfg.serve_costack,
-        costack_kernel=cfg.costack_kernel)
+        costack_kernel=cfg.costack_kernel,
+        costack_segment_trees=cfg.costack_segment_trees)
     return PredictionServer(
         catalog=catalog, host=cfg.serve_host, port=cfg.serve_port,
         model_poll_seconds=cfg.model_poll_seconds,

@@ -149,7 +149,8 @@ class GroupRuntime(PredictorRuntime):
                  group_id: str, generation: int = 1, replicas: int = 0,
                  failure_threshold: int = 3,
                  probe_after: Optional[int] = None,
-                 costack_kernel: str = "auto"):
+                 costack_kernel: str = "auto",
+                 costack_segment_trees: int = 0):
         from ..ops.predict import (resolve_costack_kernel,
                                    stack_ensemble_group)
         if len(member_ids) != len(runtimes) or not runtimes:
@@ -193,7 +194,8 @@ class GroupRuntime(PredictorRuntime):
         # dial is fleet-wide; the resolved value is part of the program
         # signature so a transplant can never cross segment<->stacked)
         self.costack_kernel = resolve_costack_kernel(
-            costack_kernel, total_trees=int(gmeta.segments[-1][1]))
+            costack_kernel, total_trees=int(gmeta.segments[-1][1]),
+            segment_trees=int(costack_segment_trees))
         # the shared request buffer: every member's data columns padded
         # to the group-wide max, plus ONE trailing tenant-id column.  A
         # member's trees never gather beyond its own columns, and

@@ -100,6 +100,10 @@ stage bench_ctr_ab python scripts/run_ctr_ab.py || exit 1
 # conflict sample is a host hazard at 50k sparse features) and 63 bins
 # so the [K, 50k, 3, B] reduced histogram fits one chip
 stage bench_ctr env BENCH_WORKLOAD=ctr BENCH_SANITIZE=1 BENCH_SPARSE_STORE=csr BENCH_ENABLE_BUNDLE=0 BENCH_ROWS=500000 BENCH_BINS=63 BENCH_LEAVES=31 BENCH_ITERS=12 python bench.py || exit 1
+# int8 sparse histograms (ISSUE 19): the integer-accumulating kernel
+# pair at the same csr shape, sanitized — validates the int8 MXU
+# contraction on chip (the >= 1.3x cells/s gate lives in run_ctr_ab)
+stage bench_ctr_int8 env BENCH_WORKLOAD=ctr BENCH_SANITIZE=1 BENCH_HIST_DTYPE=int8 BENCH_SPARSE_STORE=csr BENCH_ENABLE_BUNDLE=0 BENCH_ROWS=500000 BENCH_BINS=63 BENCH_LEAVES=31 BENCH_ITERS=12 python bench.py || exit 1
 # 2. the 63-bin variant (VERDICT #2: reference accelerator sweet spot)
 stage bench_63bin      env BENCH_BINS=63 BENCH_ITERS=12 python bench.py || exit 1
 # 3. full 500-iter north-star refreshes at HEAD

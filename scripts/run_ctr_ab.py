@@ -18,11 +18,19 @@ Four measured runs on the same synthetic wide-sparse lambdarank data
 4. adaptive bin budgets: uniform max_bin=B0 vs bin_budget set to the
    uniform run's ACTUAL total bins (same budget, adaptively allocated,
    cap 255) — held-out AUC + ndcg recorded (acceptance: adaptive >=
-   uniform at the same total).
+   uniform at the same total);
+5. int8 vs f32 sparse histograms (ISSUE 19): cells/s ratio (>= 1.3x
+   gate, enforced on the TPU backend where the int8 MXU contraction
+   exists; the XLA emulation measures parity) and held-out AUC within
+   the dense-int8 tolerance (|delta| <= 0.01);
+6. replay-densify probe: a csr train + csr valid loop must keep
+   tree/sparse_fallbacks at EXACTLY 0 (sparse binned score replay).
 
 Writes bench_ctr_measured.json (BENCH_CTR_OUT overrides).  Shape via
 BENCH_ROWS / BENCH_CTR_* envs; when the TPU backend is unreachable the
 run degrades to a reduced CPU shape and says so in the artifact.
+Acceptance gates are asserted AFTER the JSON prints/writes, so a
+failed gate still leaves the measurements on disk.
 """
 import json
 import os
@@ -223,6 +231,61 @@ def main():
             - scores["uniform"]["valid_auc"], 5),
     }
 
+    # ---- 5: int8 vs f32 sparse histograms ----------------------------
+    # Both legs run the csr store; int8 keeps the whole accumulation in
+    # integer lanes (int8 MXU contraction on chip, int32 scatter on the
+    # XLA path).  cells/s is the throughput metric (same nnz cells per
+    # iteration on both sides).  The >= 1.3x gate is an MXU property —
+    # on a non-TPU backend the XLA emulation measures parity, so the
+    # ratio is recorded honestly but only enforced on chip.
+    i8 = {}
+    for hd in ("float32", "int8"):
+        p = dict(base, sparse_store="csr", histogram_dtype=hd)
+        bst, ds, spi, deltas = _train(X, y, group, p, ITERS, WARMUP)
+        cells = deltas[profiling.SPARSE_NNZ_TOUCHED]
+        i8[hd] = {
+            "seconds_per_iter": round(spi, 4),
+            "cells_touched_per_iter": round(cells, 1),
+            "cells_per_second": round(cells / max(spi, 1e-9), 1),
+            "valid_auc": round(_auc(yv, predict_sparse(bst, Xv)), 5),
+        }
+    r_cells = (i8["int8"]["cells_per_second"]
+               / max(i8["float32"]["cells_per_second"], 1e-9))
+    d_auc = i8["int8"]["valid_auc"] - i8["float32"]["valid_auc"]
+    on_tpu = jax.default_backend() == "tpu"
+    out["int8_ab"] = {
+        "float32": i8["float32"], "int8": i8["int8"],
+        "cells_per_s_ratio_int8_over_f32": round(r_cells, 3),
+        "gate_cells_per_s_1_3x": bool(r_cells >= 1.3),
+        "gate_enforced_on_this_backend": on_tpu,
+        # quantization may cost at most what the validated dense int8
+        # path accepts (|delta AUC| <= 0.01 on held-out)
+        "auc_delta_int8_minus_f32": float(round(d_auc, 5)),
+        "gate_auc_within_dense_int8_tolerance": bool(abs(d_auc) <= 0.01),
+    }
+
+    # ---- 6: replay-densify probe -------------------------------------
+    # A csr train + csr valid loop (training, score replay, metric
+    # eval) must densify exactly NEVER: tree/sparse_fallbacks delta 0
+    # over the whole run.
+    p = dict(base, sparse_store="csr", objective="binary", metric="auc")
+    f0 = profiling.counter_value(profiling.SPARSE_FALLBACKS)
+    ds_t = lgb.Dataset(X, y).construct(p)
+    ds_v = lgb.Dataset(Xv, yv, reference=ds_t).construct(p)
+    bst = lgb.Booster(p, ds_t)
+    bst.add_valid(ds_v, "valid")
+    for _ in range(3):
+        bst.update()
+    bst._gbdt._flush_pending()
+    ev = bst.eval_valid()
+    d_fall = profiling.counter_value(profiling.SPARSE_FALLBACKS) - f0
+    out["replay_probe"] = {
+        "iters": 3,
+        "valid_metric": [(nm, m, float(round(v, 5))) for nm, m, v, _ in ev],
+        "sparse_fallbacks": int(d_fall),
+        "gate_zero_fallbacks": bool(d_fall == 0),
+    }
+
     # ---- full acceptance-shape probe (csr only) ----------------------
     # When the A/B degraded below the >= 50k-feature acceptance shape,
     # still prove the sparse path RUNS there: csr store, EFB off (the
@@ -251,6 +314,23 @@ def main():
     with open(OUT, "w") as f:
         json.dump(out, f, indent=1)
         f.write("\n")
+
+    # ---- acceptance gates: asserted AFTER the artifact prints/writes,
+    # so a failed gate still leaves the measurements on disk for triage
+    gates = [
+        ("cells_ratio_gate_5x", out["store_ab"]["cells_ratio_gate_5x"]),
+        ("trees_identical_dyadic",
+         out["store_ab"]["trees_identical_dyadic"]),
+        ("replay_zero_fallbacks",
+         out["replay_probe"]["gate_zero_fallbacks"]),
+        ("int8_auc_within_tolerance",
+         out["int8_ab"]["gate_auc_within_dense_int8_tolerance"]),
+    ]
+    if out["int8_ab"]["gate_enforced_on_this_backend"]:
+        gates.append(("int8_cells_per_s_1_3x",
+                      out["int8_ab"]["gate_cells_per_s_1_3x"]))
+    failed = [name for name, ok in gates if not ok]
+    assert not failed, f"acceptance gates failed: {failed}"
 
 
 if __name__ == "__main__":

@@ -261,6 +261,29 @@ def test_auto_kernel_resolves_segment_on_cpu(tmp_path):
     assert resolve_costack_kernel("stacked") == "stacked"
     assert resolve_costack_kernel(
         "auto", total_trees=COSTACK_SEGMENT_TREES + 1) == "segment"
+    # the switch point is the validated Config key costack_segment_trees
+    # (aliases included); <= 0 falls back to the module default, the
+    # env override wins over both and rejects garbage
+    from lightgbm_tpu.config import config_from_params
+    cfg = config_from_params({"costack_segment_threshold": 123,
+                              "verbose": -1})
+    assert cfg.costack_segment_trees == 123
+    assert config_from_params(
+        {"segment_trees_threshold": 9, "verbose": -1}
+    ).costack_segment_trees == 9
+    with pytest.raises(ValueError):
+        config_from_params({"costack_segment_trees": 0, "verbose": -1})
+    assert resolve_costack_kernel("auto", total_trees=200,
+                                  segment_trees=123) == "segment"
+    os.environ["LIGHTGBM_TPU_COSTACK_SEGMENT_TREES"] = "1000"
+    try:
+        assert resolve_costack_kernel(
+            "auto", total_trees=200, segment_trees=123) == "segment"
+        os.environ["LIGHTGBM_TPU_COSTACK_SEGMENT_TREES"] = "bogus"
+        with pytest.raises(ValueError):
+            resolve_costack_kernel("auto", total_trees=200)
+    finally:
+        del os.environ["LIGHTGBM_TPU_COSTACK_SEGMENT_TREES"]
     with pytest.raises(ValueError):
         resolve_costack_kernel("fast")
     pubs = {mid: _publish(tmp_path, mid, seed)

@@ -884,3 +884,45 @@ def test_refit_loop_steady_state_sanitized():
     assert san.steps == 4
     assert san.retraces == 0, san.compile_names
     assert san.implicit_transfers == 0
+
+
+def test_online_trainer_adaptive_bin_budget_refreezes_on_drift(tmp_path):
+    """bin_budget > 0 turns the frozen mappers adaptive: the first
+    window seeds the per-feature allocation baseline, a
+    same-distribution window leaves the mappers frozen, and a window
+    whose distribution has drifted (cardinality flip) reallocates the
+    budget and refreezes through the refbin handshake — new sidecar
+    sha1, carried by the next publish meta."""
+    from lightgbm_tpu.quantize import file_sha1
+    tr, bst, X, y, traffic, pub = _online_setup(
+        tmp_path, extra={"bin_budget": 160})
+    assert tr._rebudget
+    # gen 1: freeze mappers + seed the budget baseline
+    append_traffic(traffic, X[1000:1300], y[1000:1300])
+    assert tr.poll_once() is True
+    fp1 = tr._mapper_fp
+    assert fp1 == file_sha1(pub + ".refbin")
+    assert tr._budget_alloc is not None
+    assert tr._raw_ring == []          # ring drains every refresh
+    # gen 2: same distribution -> allocation matches -> stay frozen
+    append_traffic(traffic, X[1200:1500], y[1200:1500])
+    assert tr.poll_once() is True
+    assert tr._mapper_fp == fp1
+    assert json.load(open(pub + ".meta.json"))["refbin_sha1"] == fp1
+    # gen 3: cardinality flip on half the features -> the allocation
+    # moves past the drift threshold -> refreeze
+    rng = np.random.RandomState(0)
+    Xd = X[:300].copy()
+    Xd[:, :5] = rng.randint(0, 3, (300, 5)).astype(np.float64)
+    append_traffic(traffic, Xd, y[:300])
+    assert tr.poll_once() is True
+    fp2 = tr._mapper_fp
+    assert fp2 != fp1
+    assert fp2 == file_sha1(pub + ".refbin")
+    # gen 4 publishes against the NEW mappers and advertises them
+    append_traffic(traffic, Xd, y[300:600])
+    assert tr.poll_once() is True
+    assert json.load(open(pub + ".meta.json"))["refbin_sha1"] == fp2
+    # the published model still loads and predicts
+    nb = lgb.Booster(params={"verbose": -1}, model_file=pub)
+    assert np.isfinite(nb.predict(X[:64])).all()

@@ -501,3 +501,257 @@ def test_capi_sparse_predict_chunks_match_dense():
         np.testing.assert_allclose(out2, ref, rtol=1e-6)
     finally:
         gmod.GBDT._PREDICT_CHUNK = old
+
+
+# ---------------------------------------------------------------------------
+# int8 sparse kernels + trees
+# ---------------------------------------------------------------------------
+
+def test_sparse_int8_kernels_bitwise_xla_vs_pallas_skewed():
+    """int8 sparse parity for ARBITRARY real-valued gradients: both
+    kernels accumulate the SAME quantized integers exactly (int32
+    scatter-add vs int8-MXU dot with int32 accumulation, integer slot
+    totals + integer zero-bin residual, ONE dequantizing scale at the
+    end), so XLA == Pallas(interpret) BITWISE.  A power-law column
+    distribution makes the hottest column exceed SPARSE_CHUNK entries,
+    exercising the hot-column slot fold in unscatter_slot_hist on the
+    quantized path too."""
+    from lightgbm_tpu.ops.histogram import (hist_multileaf_masked,
+                                            hist_sparse_pallas,
+                                            hist_sparse_xla,
+                                            sparse_window_streams)
+    rng = np.random.RandomState(11)
+    N, C, B, draws = 1024, 64, 64, 12
+    raw = np.minimum((C * rng.rand(N, draws) ** 4).astype(np.int64),
+                     C - 1)
+    zb = rng.randint(0, 3, C).astype(np.int32)
+    R = nnz_capacity_tier(draws)
+    cols = np.full((N, R), C, np.int32)
+    binsv = np.zeros((N, R), np.int32)
+    for i in range(N):               # unique per row: a valid ELL store
+        u = np.unique(raw[i])
+        cols[i, : u.size] = u
+        binsv[i, : u.size] = rng.randint(1, B - 1, u.size)
+    lid = rng.randint(0, 6, N).astype(np.int32)
+    gh8 = np.zeros((8, N), np.float32)
+    gh8[0] = rng.randn(N).astype(np.float32)          # real-valued
+    gh8[1] = np.abs(rng.randn(N)).astype(np.float32)
+    gh8[2] = (rng.rand(N) > 0.1).astype(np.float32)
+    gh8[0] *= gh8[2]
+    gh8[1] *= gh8[2]
+    sl = np.array([0, 2, 5, -1], np.int32)
+    hx = np.asarray(hist_sparse_xla(
+        jnp.asarray(cols), jnp.asarray(binsv), jnp.asarray(zb),
+        jnp.asarray(lid), jnp.asarray(gh8), jnp.asarray(sl),
+        num_columns_padded=C, num_bins_padded=B, input_dtype="int8"))
+    er, ef, ev, sc = sparse_window_streams(cols, binsv, C,
+                                           num_bins_padded=B)
+    # the skew actually split a hot column across slots
+    assert np.bincount(sc[sc < C], minlength=C).max() >= 2
+    hp = np.asarray(hist_sparse_pallas(
+        jnp.asarray(er), jnp.asarray(ef), jnp.asarray(ev),
+        jnp.asarray(sc), jnp.asarray(zb), jnp.asarray(lid),
+        jnp.asarray(gh8), jnp.asarray(sl), num_columns_padded=C,
+        num_bins_padded=B, input_dtype="int8", interpret=True))
+    np.testing.assert_array_equal(hx, hp)
+    # the count channel never quantizes (mask scale is exactly 1.0):
+    # it must equal the f32 dense reference bitwise
+    dense = np.tile(zb[:, None], (1, N)).astype(np.int32)
+    live = cols < C
+    rr, ss = np.nonzero(live)
+    dense[cols[rr, ss], rr] = binsv[rr, ss]
+    hd = np.asarray(hist_multileaf_masked(
+        jnp.asarray(dense), jnp.asarray(lid), jnp.asarray(gh8),
+        jnp.asarray(sl), num_bins_padded=B, backend="xla",
+        input_dtype="float32"))
+    np.testing.assert_array_equal(hd[:, :, 2], hx[:, :, 2])
+    # quantized grad/hess channels land within the per-entry bound
+    np.testing.assert_allclose(hd[:, :, :2], hx[:, :, :2], rtol=0,
+                               atol=N * max(np.abs(gh8[0]).max(),
+                                            np.abs(gh8[1]).max()) / 254)
+
+
+def test_sparse_int8_trees_bitwise_vs_dense_int8():
+    """histogram_dtype=int8 through the rounds learner: gradients of
+    +-127 quantize at scale exactly 1.0 and hessians of 63.5 at scale
+    exactly 0.5, so the dense path's per-entry dequantized f32 sums and
+    the sparse path's integer sums describe the SAME exact numbers —
+    int8 sparse trees must equal int8 dense trees bitwise."""
+    X, y = _sparse_X()
+    g = jnp.asarray(np.where(y > 0, -127.0, 127.0).astype(np.float32))
+    h = jnp.asarray(np.full(len(y), 63.5, np.float32))
+    trees = {}
+    for store in ("dense", "csr"):
+        cfg = _cfg(sparse_store=store, histogram_dtype="int8")
+        ds = RawDataset(X, y, config=cfg)
+        t, lid = RoundsTreeLearner(ds, cfg).train(g, h)
+        trees[store] = (t, np.asarray(lid))
+    td, ts = trees["dense"][0], trees["csr"][0]
+    assert td.num_leaves == ts.num_leaves > 1
+    assert _splits(td) == _splits(ts)
+    np.testing.assert_array_equal(
+        td.leaf_value[: td.num_leaves], ts.leaf_value[: ts.num_leaves])
+    np.testing.assert_array_equal(trees["dense"][1], trees["csr"][1])
+
+
+# ---------------------------------------------------------------------------
+# sparse binned score replay
+# ---------------------------------------------------------------------------
+
+def _replay_booster(store, Xtr, ytr, Xv, yv, rounds=4):
+    """Booster with a csr/dense train store and a SAME-store valid set,
+    boosted with dyadic custom gradients (every histogram partial sum
+    exact in f32 -> trees and leaf values bitwise across stores)."""
+    import lightgbm_tpu as lgb
+    p = {"objective": "binary", "verbose": -1, "num_leaves": 15,
+         "min_data_in_leaf": 10, "tree_growth": "rounds",
+         "enable_bundle": False, "sparse_store": store}
+    ds = lgb.Dataset(Xtr, ytr, params=p).construct()
+    vds = lgb.Dataset(Xv, yv, params=p, reference=ds).construct()
+    assert (ds._inner.sparse is not None) == (store == "csr")
+    assert (vds._inner.sparse is not None) == (store == "csr")
+    bst = lgb.Booster(p, ds)
+    bst.add_valid(vds, "v")
+    ys = np.where(ytr > 0, 1.0, -1.0)
+    step = {"i": 0}
+
+    def fobj(preds, dtrain):
+        step["i"] += 1
+        g = np.where(preds >= ys * step["i"] * 0.125, 0.25, -0.25)
+        return g.astype(np.float32), np.full(len(g), 0.5, np.float32)
+
+    for _ in range(rounds):
+        bst.update(fobj=fobj)
+    bst._gbdt._flush_pending()
+    train = np.asarray(bst._gbdt.train_score.get()).ravel().copy()
+    valid = np.asarray(bst._gbdt.valid_sets[0][2].get()).ravel().copy()
+    return bst, train, valid
+
+
+def test_sparse_replay_bitwise_vs_dense_replay_dyadic():
+    """The sparse binned valid replay (ELL walk, no densify) must land
+    EXACTLY where the dense binned replay lands: with dyadic custom
+    gradients the two stores grow bitwise-identical trees, traversal
+    decisions are exact bin compares either way, and leaf values
+    accumulate in the same order -> train AND valid scores bitwise."""
+    Xtr, ytr = _sparse_X(seed=3)
+    Xv, yv = _sparse_X(seed=9)
+    c0 = profiling.counter_value(profiling.SPARSE_FALLBACKS)
+    _, tr_s, va_s = _replay_booster("csr", Xtr, ytr, Xv, yv)
+    # the whole csr leg -- construct, train, valid replay -- never
+    # densified
+    assert profiling.counter_value(profiling.SPARSE_FALLBACKS) == c0
+    _, tr_d, va_d = _replay_booster("dense", Xtr, ytr, Xv, yv)
+    np.testing.assert_array_equal(tr_d, tr_s)
+    np.testing.assert_array_equal(va_d, va_s)
+
+
+def test_sparse_fallbacks_zero_csr_train_and_valid():
+    """Pinned acceptance criterion: a csr train + valid-eval run keeps
+    tree/sparse_fallbacks EXACTLY at zero — histograms, partitions,
+    score replay, and metric evaluation all walk the ELL store."""
+    import lightgbm_tpu as lgb
+    Xtr, ytr = _sparse_X(seed=3)
+    Xv, yv = _sparse_X(seed=9)
+    p = {"objective": "binary", "verbose": -1, "num_leaves": 15,
+         "min_data_in_leaf": 10, "tree_growth": "rounds",
+         "enable_bundle": False, "sparse_store": "csr",
+         "metric": "binary_logloss"}
+    c0 = profiling.counter_value(profiling.SPARSE_FALLBACKS)
+    ds = lgb.Dataset(Xtr, ytr, params=p).construct()
+    vds = lgb.Dataset(Xv, yv, params=p, reference=ds).construct()
+    bst = lgb.Booster(p, ds)
+    bst.add_valid(vds, "v")
+    for _ in range(4):
+        bst.update()
+    bst._gbdt._flush_pending()
+    res = bst.eval_valid()
+    assert res and np.isfinite(res[0][2])
+    assert profiling.counter_value(profiling.SPARSE_FALLBACKS) == c0
+
+
+def test_sparse_replay_steady_state_sanitized_zero_retrace():
+    """Sanitize-marked 0/0 loop WITH a sparse valid set attached: the
+    steady-state train + replay iteration neither retraces nor
+    implicitly transfers after warmup (the sparse walk programs are as
+    shape-stable as the dense ones)."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.diagnostics.sanitize import HotPathSanitizer
+    Xtr, ytr = _sparse_X(seed=3)
+    Xv, yv = _sparse_X(seed=5)
+    p = {"objective": "binary", "verbose": -1, "num_leaves": 15,
+         "min_data_in_leaf": 10, "tree_growth": "rounds",
+         "enable_bundle": False, "sparse_store": "csr"}
+    ds = lgb.Dataset(Xtr, ytr, params=p).construct()
+    vds = lgb.Dataset(Xv, yv, params=p, reference=ds).construct()
+    bst = lgb.Booster(p, ds)
+    bst.add_valid(vds, "v")
+    c0 = profiling.counter_value(profiling.SPARSE_FALLBACKS)
+    for _ in range(3):                 # warm: compiles train + replay
+        bst.update()
+    with HotPathSanitizer(warmup=1, label="sparse/replay") as san:
+        for _ in range(3):
+            with san.step():
+                bst.update()
+    assert san.retraces == 0, san.report()
+    assert san.implicit_transfers == 0, san.report()
+    assert profiling.counter_value(profiling.SPARSE_FALLBACKS) == c0
+
+
+# ---------------------------------------------------------------------------
+# sharded sparse feeds (fused learners)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("lt,mesh_kind", [
+    ("serial", None), ("data", "data"), ("feature", "feature"),
+    ("data2d", "data2d"), ("voting", "voting")])
+def test_fused_sparse_feed_trees_bitwise_vs_dense(lt, mesh_kind):
+    """Every fused learner consumes the sparse ELL feed directly —
+    per-shard windows for feature sharding, the EFB-decoded unbundled
+    feed when a bundle plan exists — and grows BITWISE-identical trees
+    and leaf routes vs its dense feed, with zero sparse fallbacks."""
+    from lightgbm_tpu.learner.fused import FusedTreeLearner, make_mesh
+    rng = np.random.RandomState(7)
+    n = 1201
+    dense_part = rng.randn(n, 4) * (rng.rand(n, 4) < 0.3)
+    onehot = np.zeros((n, 16))
+    onehot[np.arange(n), rng.randint(0, 16, n)] = rng.rand(n) + 0.5
+    X = np.concatenate([dense_part, onehot], axis=1)  # EFB-bundleable
+    y = (X[:, 0] + 0.5 * X[:, 1] - X[:, 2]
+         + 0.1 * rng.randn(n) > 0).astype(np.float64)
+    grad = jnp.asarray((rng.randint(-8, 9, size=n) * 0.125)
+                       .astype(np.float32))           # dyadic: exact
+    hess = jnp.asarray(np.ones(n, np.float32))
+    mesh = make_mesh(mesh_kind) if mesh_kind else None
+    if mesh_kind and mesh is None:
+        pytest.skip(f"not enough devices for a {mesh_kind} mesh")
+
+    def sig(t):
+        k = t.num_leaves - 1
+        return (t.num_leaves, t.split_feature_inner[:k].tolist(),
+                t.threshold_in_bin[:k].tolist(),
+                t.left_child[:k].tolist(),
+                t.leaf_value[: t.num_leaves].tobytes())
+
+    for bundle in (False, True):
+        trees = {}
+        for store in ("dense", "csr"):
+            cfg = config_from_params({
+                "objective": "binary", "num_leaves": 15,
+                "min_data_in_leaf": 20, "verbose": -1, "top_k": 6,
+                "sparse_store": store, "enable_bundle": bundle,
+                "tree_learner": lt})
+            ds = RawDataset(X, y, config=cfg)
+            if store == "csr":
+                assert ds.sparse is not None
+                assert (ds.bundle_plan is not None) == bundle
+                c0 = profiling.counter_value(profiling.SPARSE_FALLBACKS)
+            t, lid = FusedTreeLearner(ds, cfg, mesh).train(grad, hess)
+            if store == "csr":
+                assert profiling.counter_value(
+                    profiling.SPARSE_FALLBACKS) == c0, (bundle, lt)
+            trees[store] = (t, np.asarray(lid))
+        assert sig(trees["dense"][0]) == sig(trees["csr"][0]), \
+            (bundle, lt)
+        np.testing.assert_array_equal(trees["dense"][1],
+                                      trees["csr"][1])
