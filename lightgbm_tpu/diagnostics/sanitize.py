@@ -60,6 +60,24 @@ COMPILES_TOTAL = "sanitize/compiles_total"
 DIVERGENCE_CHECKS = "sanitize/divergence_checks"
 DIVERGENCES = "sanitize/divergences"
 
+# Lock-sanitizer counters (diagnostics/locksan.py — the runtime half of
+# the threadlint static rules, the way DivergenceSanitizer is shardlint's):
+#  - LOCK_ACQUIRES: outermost acquisitions seen by the instrumented shim
+#    (>0 proves the shim was armed and actually on the benched path);
+#  - LOCK_WAITS: acquisitions that found the lock busy and had to block
+#    (the contention metric; the wait itself lands in LOCK_WAIT_MS);
+#  - LOCK_CYCLES: lock-ORDER cycles detected at acquire time — a thread
+#    acquired B-then-A after some thread established A-then-B.  The
+#    serving benches assert this stays 0 (a nonzero value is a latent
+#    ABBA deadlock that timing has not yet cashed in).
+# LOCK_HOLD_MS / LOCK_WAIT_MS are bounded sample reservoirs (per-lock
+# labeled series ride the same base names via profiling.labeled).
+LOCK_ACQUIRES = "sanitize/lock_acquires"
+LOCK_WAITS = "sanitize/lock_waits"
+LOCK_CYCLES = "sanitize/lock_cycles"
+LOCK_HOLD_MS = "sanitize/lock_hold_ms"
+LOCK_WAIT_MS = "sanitize/lock_wait_ms"
+
 # Retrace signal: "Finished tracing + transforming <name> for pjit" fires
 # on every (re)trace, INCLUDING compiles served from the persistent
 # compilation cache (which skip the "Compiling <name>" backend message
@@ -286,6 +304,12 @@ class HotPathSanitizer:
         self.divergence_checks = 0
         self.divergences = 0
         self._div0 = (0.0, 0.0)
+        # lock-sanitizer counters over this window (diagnostics/locksan
+        # feeds the profiling registry when armed; zero when disarmed)
+        self.lock_acquires = 0
+        self.lock_waits = 0
+        self.lock_cycles = 0
+        self._lock0 = (0.0, 0.0, 0.0)
         self._handler: Optional[_CompileCounter] = None
         self._prev_log_compiles = None
         self._prev_propagate = None
@@ -303,6 +327,9 @@ class HotPathSanitizer:
         jax.config.update("jax_log_compiles", True)
         self._div0 = (profiling.counter_value(DIVERGENCE_CHECKS),
                       profiling.counter_value(DIVERGENCES))
+        self._lock0 = (profiling.counter_value(LOCK_ACQUIRES),
+                       profiling.counter_value(LOCK_WAITS),
+                       profiling.counter_value(LOCK_CYCLES))
         return self
 
     def __exit__(self, exc_type, exc, tb) -> bool:
@@ -321,6 +348,12 @@ class HotPathSanitizer:
             profiling.counter_value(DIVERGENCE_CHECKS) - self._div0[0])
         self.divergences = int(
             profiling.counter_value(DIVERGENCES) - self._div0[1])
+        self.lock_acquires = int(
+            profiling.counter_value(LOCK_ACQUIRES) - self._lock0[0])
+        self.lock_waits = int(
+            profiling.counter_value(LOCK_WAITS) - self._lock0[1])
+        self.lock_cycles = int(
+            profiling.counter_value(LOCK_CYCLES) - self._lock0[2])
         return False
 
     # -- per-iteration accounting --------------------------------------
@@ -378,6 +411,11 @@ class HotPathSanitizer:
             # meshes with BENCH_SANITIZE on)
             "divergence_checks": self.divergence_checks,
             "divergences": self.divergences,
+            # lock-order audit over this window (diagnostics/locksan;
+            # acquires > 0 proves the instrumented shim was armed)
+            "lock_acquires": self.lock_acquires,
+            "lock_waits": self.lock_waits,
+            "lock_cycles": self.lock_cycles,
             # first offending program names — the evidence a regression
             # report needs to find the retracing call site
             "retrace_names": self.compile_names[-8:] if self.retraces else [],
@@ -385,12 +423,17 @@ class HotPathSanitizer:
 
     def check(self) -> None:
         """Raise with a diagnostic when the zero/zero/zero contract is
-        broken (retraces, implicit transfers, cross-shard divergences)."""
-        if self.retraces or self.implicit_transfers or self.divergences:
+        broken (retraces, implicit transfers, cross-shard divergences,
+        lock-order cycles)."""
+        if self.retraces or self.implicit_transfers or self.divergences \
+                or self.lock_cycles:
+            from . import locksan
             raise AssertionError(
                 f"hot-path sanitizer [{self.label}]: "
                 f"{self.retraces} retrace(s), "
-                f"{self.implicit_transfers} implicit transfer(s) and "
-                f"{self.divergences} cross-shard divergence(s) after "
+                f"{self.implicit_transfers} implicit transfer(s), "
+                f"{self.divergences} cross-shard divergence(s) and "
+                f"{self.lock_cycles} lock-order cycle(s) after "
                 f"{self.warmup} warmup step(s) over {self.steps} steps; "
-                f"recent compiles: {self.compile_names[-8:]}")
+                f"recent compiles: {self.compile_names[-8:]}; "
+                f"lock cycles: {locksan.cycles()[:4]}")

@@ -36,6 +36,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..diagnostics import locksan
+
 
 def append_traffic(path: str, X: np.ndarray, y: np.ndarray,
                    weight: Optional[np.ndarray] = None,
@@ -288,7 +290,7 @@ class TrafficDemux:
     def __init__(self, path: str, max_poll_bytes: int = 64 << 20):
         self.path = path
         self._max_poll = int(max_poll_bytes)
-        self._lock = threading.Lock()
+        self._lock = locksan.lock("online.stream")
         self._views: List["TrafficDemuxView"] = []
         self._records: deque = deque()
         self._pos: Optional[int] = None   # parse cursor; lazy until the

@@ -336,6 +336,7 @@ def snapshot() -> Tuple[Dict[str, float], Dict[str, Dict[str, float]]]:
     (counters, {name: summary}) — deferred device totals drain here
     (the scrape pays the sync, same contract as counters())."""
     with _lock:
+        # graftlint: allow(blocking-under-lock) — the deferred drain syncs device buffers under _lock BY CONTRACT: the scrape pays the one sync so hot paths never do (counters_nosync is the lock-free read)
         _drain_deferred_locked()
         ctrs = dict(_counters)
         sums = {name: _summary_of(sorted(dq))

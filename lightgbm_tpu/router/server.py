@@ -58,7 +58,7 @@ from typing import Dict, List, Optional, Tuple
 from .. import log, profiling, telemetry
 from ..httpd import SeveringHTTPServer
 from ..config import MODEL_ID_RE, Config, parse_route_backends
-from ..diagnostics import faults
+from ..diagnostics import faults, locksan
 from ..log import LightGBMError
 from .placement import HashRing, _point
 
@@ -406,7 +406,7 @@ class RouterServer:
         self.max_inflight = int(max_inflight)
         self.failure_threshold = max(int(failure_threshold), 1)
         self.group_spread = max(int(group_spread), 1)
-        self._lock = threading.Lock()
+        self._lock = locksan.lock("route.server")
         # model id -> co-stack group key, merged from the backends'
         # /healthz "group_keys" payloads (see _placement_key)
         self._group_keys: Dict[str, str] = {}

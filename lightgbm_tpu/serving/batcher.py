@@ -36,6 +36,7 @@ from typing import Deque, List, Optional
 import numpy as np
 
 from .. import log, profiling, telemetry
+from ..diagnostics import locksan
 from ..log import LightGBMError
 
 # monotonic clock for ALL deadline math — module-level and injectable so
@@ -106,7 +107,7 @@ class MicroBatcher:
         # queue sheds ITS load while quiet neighbors keep admitting
         self.pending_caps = dict(pending_caps or {})
         self._pending_by_model: dict = {}
-        self._cond = threading.Condition()
+        self._cond = locksan.condition("serve.batcher")
         self._queue: Deque[_Request] = deque()
         self._rows_pending = 0
         self._closed = False
@@ -272,7 +273,10 @@ class MicroBatcher:
         if hasattr(runtime, "predict_mixed"):
             self._flush_mixed(batch, runtime)
             return
-        self.batches_flushed += 1
+        with self._cond:
+            # flusher threads race on this read-modify-write; the stats
+            # endpoints read it live
+            self.batches_flushed += 1
         profiling.count("serve.batches")
         # group by (kind, feature width) so a malformed request only
         # fails its own group, never the neighbors that batched with it
@@ -339,7 +343,10 @@ class MicroBatcher:
         one launch per chunk, and the demuxed per-request answers are
         charged — latency, dispatch events, shadow comparisons — to
         each request's OWN tenant, never to the group."""
-        self.batches_flushed += 1
+        with self._cond:
+            # same read-modify-write race as _flush: workers > 1 means
+            # concurrent mixed flushes
+            self.batches_flushed += 1
         profiling.count("serve.batches")
         # group by kind only: member widths differ legitimately (each
         # request validates against its own tenant's feature contract

@@ -60,6 +60,7 @@ from typing import Dict, List, Optional, Tuple
 
 from .. import log, profiling
 from ..config import MODEL_ID_RE
+from ..diagnostics import locksan
 from ..log import LightGBMError
 from .batcher import MicroBatcher
 from .registry import ModelRegistry
@@ -259,7 +260,7 @@ class ModelCatalog:
         path."""
         self.default_id = default_id
         self.cache_budget_mb = max(0, int(cache_budget_mb))
-        self._lock = threading.Lock()        # LRU ticks + eviction scan
+        self._lock = locksan.lock("serve.catalog")   # LRU ticks + eviction scan
         self._tick = itertools.count(1)
         self._miss_mark = -1                 # submit-path dirty check
         self._tenants: Dict[str, _Tenant] = {}

@@ -39,6 +39,7 @@ from typing import Optional, Sequence, Tuple
 import numpy as np
 
 from .. import log, profiling, telemetry
+from ..diagnostics import locksan
 from ..log import LightGBMError
 from .runtime import OUTPUT_KINDS, PredictorRuntime
 
@@ -115,7 +116,7 @@ class ModelRegistry:
         self._candidate: Optional[PredictorRuntime] = None
         self._candidate_sig: Optional[Tuple[int, int, Optional[str]]] = None
         self._candidate_trace: Optional[str] = None
-        self._shadow_lock = threading.Lock()  # shadow counters +
+        self._shadow_lock = locksan.lock("serve.registry.shadow")  # shadow counters +
         # candidate identity.  Lock ORDER: _lock → _shadow_lock (the
         # staging branch and the verdict both nest that way; nothing
         # acquires _lock while holding _shadow_lock).  The hot
@@ -126,7 +127,7 @@ class ModelRegistry:
         self._shadow_scored = 0
         self._shadow_max_div = 0.0
         self.last_swap_error: Optional[str] = None
-        self._lock = threading.Lock()       # serializes WRITERS only
+        self._lock = locksan.lock("serve.registry")  # serializes WRITERS only
         self._failed_sig: Optional[Tuple[int, int, Optional[str]]] = None
         self._hup_pending = False
         # stat BEFORE loading (like maybe_reload): a file replaced during

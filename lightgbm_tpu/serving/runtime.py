@@ -60,7 +60,7 @@ from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import log, profiling, telemetry
-from ..diagnostics import faults
+from ..diagnostics import faults, locksan
 from ..log import LightGBMError
 
 OUTPUT_KINDS = ("value", "raw")
@@ -285,7 +285,7 @@ class PredictorRuntime:
             max_workers=len(self.replicas),
             thread_name_prefix="lgbt-serve-fanout")
             if len(self.replicas) > 1 else None)
-        self._lock = threading.Lock()
+        self._lock = locksan.lock("serve.runtime")
         self._rr = 0                  # round-robin tie-break cursor
         self.cache_hits = 0
         self.cache_misses = 0
@@ -719,7 +719,10 @@ class PredictorRuntime:
             # failed; its executable cache is as warm as the failed
             # one's (warmup covers every replica), so the retry never
             # compiles on the request path
-            self.chunk_retries += 1
+            with self._lock:
+                # chunks retry concurrently on the fan-out pool; this
+                # read-modify-write needs the runtime lock
+                self.chunk_retries += 1
             profiling.count(profiling.SERVE_CHUNK_RETRIES)
             try:
                 out = self._run_compiled(bucket, kind, X,

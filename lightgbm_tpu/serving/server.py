@@ -41,6 +41,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from .. import log, profiling, telemetry
+from ..diagnostics import locksan, sanitize
 from ..httpd import SeveringHTTPServer
 from ..config import MODEL_ID_RE, Config, parse_serve_models
 from ..log import LightGBMError
@@ -453,6 +454,21 @@ class PredictionServer:
             "phase_totals_s": {k: round(v, 6)
                                for k, v in profiling.timings().items()
                                if k.startswith("serve/")},
+            # LockSanitizer verdict (diagnostics/locksan.py): armed
+            # under LIGHTGBM_TPU_LOCKSAN/BENCH_SANITIZE, lock_cycles
+            # MUST stay 0 — a nonzero here is a latent ABBA deadlock
+            # witnessed on this process's actual acquisitions
+            "locksan": {
+                "armed": locksan.armed(),
+                "lock_acquires": profiling.counter_value(
+                    sanitize.LOCK_ACQUIRES),
+                "lock_waits": profiling.counter_value(
+                    sanitize.LOCK_WAITS),
+                "lock_cycles": profiling.counter_value(
+                    sanitize.LOCK_CYCLES),
+                "lock_hold_ms": profiling.summary(sanitize.LOCK_HOLD_MS),
+                "cycles": locksan.cycles()[:4],
+            },
         }
 
     # -- lifecycle ------------------------------------------------------

@@ -453,8 +453,15 @@ def prometheus_text(gauges: Optional[Dict[str, float]] = None) -> str:
     Pending `count_deferred` device totals drain here — the scrape pays
     the sync, the hot path never does."""
     from . import profiling
+    from .diagnostics import sanitize
     counters, summaries = profiling.snapshot()
     for name in profiling.CANONICAL_COUNTERS:
+        counters.setdefault(name, 0.0)
+    # LockSanitizer counters (diagnostics/locksan.py) are canonical the
+    # same way: a scrape always shows lgbt_sanitize_lock_cycles_total,
+    # so "0" is an observed verdict, not a missing series
+    for name in (sanitize.LOCK_ACQUIRES, sanitize.LOCK_WAITS,
+                 sanitize.LOCK_CYCLES):
         counters.setdefault(name, 0.0)
     lines = []
     cfams = _families(counters)

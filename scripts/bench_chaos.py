@@ -89,7 +89,7 @@ def main():
     import jax
 
     import lightgbm_tpu as lgb
-    from lightgbm_tpu.diagnostics import faults
+    from lightgbm_tpu.diagnostics import faults, locksan
     from lightgbm_tpu.diagnostics.sanitize import (HotPathSanitizer,
                                                    sanitize_enabled)
     from lightgbm_tpu.config import config_from_params
@@ -253,6 +253,8 @@ def main():
     out["seconds_total"] = round(time.perf_counter() - t_start, 2)
     if sanitize:
         out["sanitize"] = san.report()
+    if locksan.armed():
+        out["locksan"] = locksan.report()
     if note:
         out["note"] = note
     print(json.dumps(out))
@@ -281,6 +283,8 @@ def main():
             f"serve loop retraced under faults: {san.compile_names}")
         assert san.implicit_transfers == 0, (
             "serve loop moved data implicitly under faults")
+    if locksan.armed():
+        locksan.check()  # 0 lock-order cycles across the whole drill
 
 
 class _noop:

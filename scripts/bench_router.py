@@ -311,6 +311,15 @@ def main():
             profiling.ROUTER_RETRIES),
     }
 
+    # LockSanitizer verdict: the router process's own acquisition-order
+    # graph, plus each live backend's verdict over its /stats (the
+    # backends inherit BENCH_SANITIZE and arm their own shims)
+    from lightgbm_tpu.diagnostics import locksan
+    out["locksan"] = locksan.report()
+    out["locksan"]["backends"] = {
+        str(p): get_json(p, "/stats").get("locksan")
+        for p in (port_a, port_b)}
+
     out["seconds_total"] = round(time.perf_counter() - t_start, 2)
     if note:
         out["note"] = note
@@ -341,6 +350,13 @@ def main():
         f"chaos p99 {c99}ms unbounded vs routed p99 {r99}ms")
     assert compiles_measured == 0, (
         "the measured phases compiled on the request path")
+    if locksan.armed():
+        locksan.check()              # 0 lock-order cycles in the router
+        for addr, rec in out["locksan"]["backends"].items():
+            if rec is None:
+                continue
+            assert rec.get("lock_cycles", 0) == 0, (
+                f"backend :{addr} witnessed lock-order cycles: {rec}")
 
 
 if __name__ == "__main__":

@@ -284,6 +284,7 @@ def _multi_tenant_main() -> None:
     block, eviction churn under SERVE_BENCH_CACHE_MB, per-tenant
     sanitize probe."""
     from lightgbm_tpu import profiling
+    from lightgbm_tpu.diagnostics import locksan
     from lightgbm_tpu.diagnostics.sanitize import (HotPathSanitizer,
                                                    sanitize_enabled)
     from lightgbm_tpu.serving import ModelCatalog, PredictionServer
@@ -384,6 +385,8 @@ def _multi_tenant_main() -> None:
     }
     if san_rec:
         out["sanitize"] = san_rec
+    if locksan.armed():
+        out["locksan"] = locksan.report()
     line = json.dumps(out)
     print(line)
     dest = os.environ.get("SERVE_BENCH_OUT", "")
@@ -396,10 +399,13 @@ def _multi_tenant_main() -> None:
                              f"{rec['error']}")
     for san in sans:
         san.check()     # fail AFTER the JSON so counters are recorded
+    if locksan.armed():
+        locksan.check()  # 0 lock-order cycles across the whole window
 
 
 def main() -> None:
     from lightgbm_tpu import profiling
+    from lightgbm_tpu.diagnostics import locksan
     from lightgbm_tpu.diagnostics.sanitize import (HotPathSanitizer,
                                                    sanitize_enabled)
     from lightgbm_tpu.serving import ModelRegistry, PredictionServer
@@ -495,6 +501,8 @@ def main() -> None:
     }
     if san_rec:
         out["sanitize"] = san_rec
+    if locksan.armed():
+        out["locksan"] = locksan.report()
     line = json.dumps(out)
     print(line)
     dest = os.environ.get("SERVE_BENCH_OUT", "")
@@ -507,6 +515,8 @@ def main() -> None:
                              f"{rec['error']}")
     for san in sans:
         san.check()     # fail AFTER the JSON so counters are recorded
+    if locksan.armed():
+        locksan.check()  # 0 lock-order cycles across the whole window
     if os.environ.get("SERVE_BENCH_REQUIRE_SPEEDUP", ""):
         need = float(os.environ["SERVE_BENCH_REQUIRE_SPEEDUP"])
         if ab["speedup"] < need:

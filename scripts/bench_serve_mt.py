@@ -259,6 +259,8 @@ def _run_side(models, tenant_ids, X, Xfix, costack, warm, san_label,
 
 
 def main() -> None:
+    from lightgbm_tpu.diagnostics import locksan
+
     t_train0 = time.monotonic()
     fits, X = _train_fits()
     train_s = time.monotonic() - t_train0
@@ -341,6 +343,8 @@ def main() -> None:
     }
     if san_rec:
         out["sanitize"] = san_rec
+    if locksan.armed():
+        out["locksan"] = locksan.report()
     line = json.dumps(out)
     print(line)
     dest = os.environ.get("SERVE_MT_OUT", "")
@@ -353,6 +357,8 @@ def main() -> None:
         raise SystemExit(1)
     for san in sans:
         san.check()     # fail AFTER the JSON so counters are recorded
+    if locksan.armed():
+        locksan.check()  # 0 lock-order cycles across the whole window
 
 
 if __name__ == "__main__":

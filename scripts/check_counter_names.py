@@ -44,6 +44,7 @@ CALLS = ("count", "count_deferred", "observe", "labeled")
 CONSTANT_MODULES = (
     os.path.join("lightgbm_tpu", "profiling.py"),
     os.path.join("lightgbm_tpu", "diagnostics", "sanitize.py"),
+    os.path.join("lightgbm_tpu", "diagnostics", "locksan.py"),
 )
 
 

@@ -56,34 +56,34 @@ stage profile env BENCH_SANITIZE=1 python scripts/profile_hotpath.py || exit 1
 # implicit transfers at steady state — fails AFTER its JSON prints)
 # and on binned throughput >= raw (the fixed-point traversal's
 # memory-bandwidth win must be real on chip)
-stage bench_serve env BENCH_SANITIZE=1 SERVE_BENCH_SECONDS=10 SERVE_BENCH_REQUIRE_BINNED=1.0 SERVE_BENCH_OUT=.bench/bench_serve.json python scripts/bench_serve.py || exit 1
+stage bench_serve env BENCH_SANITIZE=1 LIGHTGBM_TPU_LOCKSAN=1 SERVE_BENCH_SECONDS=10 SERVE_BENCH_REQUIRE_BINNED=1.0 SERVE_BENCH_OUT=.bench/bench_serve.json python scripts/bench_serve.py || exit 1
 # multi-tenant catalog: 3 tenants at mixed QPS on one fleet —
 # per-model p99 + /stats accounting, LRU eviction churn under a
 # deliberately tight executable budget, and the per-tenant
 # steady-state sanitize probe (0 retraces / 0 implicit transfers)
-stage bench_serve_catalog env BENCH_SANITIZE=1 SERVE_BENCH_TENANTS=3 SERVE_BENCH_SECONDS=8 SERVE_BENCH_CACHE_MB=64 SERVE_BENCH_OUT=.bench/bench_serve_catalog.json python scripts/bench_serve.py || exit 1
+stage bench_serve_catalog env BENCH_SANITIZE=1 LIGHTGBM_TPU_LOCKSAN=1 SERVE_BENCH_TENANTS=3 SERVE_BENCH_SECONDS=8 SERVE_BENCH_CACHE_MB=64 SERVE_BENCH_OUT=.bench/bench_serve_catalog.json python scripts/bench_serve.py || exit 1
 # cross-model co-stack A/B: the same fleet at 10 and 100 tenants with
 # serve_costack off vs on — compiled-executable ratio gated >= 5x,
 # co-stack p99 gated no worse than 1.1x solo, per-tenant answers
 # asserted bitwise equal, 0 request-path compiles on both sides, and
 # the mixed-batch steady-state sanitize probe on the group runtime
-stage bench_serve_mt env BENCH_SANITIZE=1 SERVE_MT_SECONDS=8 SERVE_MT_REQUIRE_RATIO=5 SERVE_MT_REQUIRE_P99=1.1 SERVE_MT_OUT=.bench/bench_serve_mt.json python scripts/bench_serve_mt.py || exit 1
+stage bench_serve_mt env BENCH_SANITIZE=1 LIGHTGBM_TPU_LOCKSAN=1 SERVE_MT_SECONDS=8 SERVE_MT_REQUIRE_RATIO=5 SERVE_MT_REQUIRE_P99=1.1 SERVE_MT_OUT=.bench/bench_serve_mt.json python scripts/bench_serve_mt.py || exit 1
 # online-learning refresh loop at the reduced north-star shape:
 # refit-vs-retrain wall-clock (>= 10x gate) + AUC-after-drift recovery,
 # steady-state refits under the sanitizer (0 retraces / 0 implicit
 # transfers per refresh) — refreshes the committed artifact
-stage bench_online env BENCH_SANITIZE=1 BENCH_ONLINE_OUT=bench_online_measured.json python scripts/bench_online.py || exit 1
+stage bench_online env BENCH_SANITIZE=1 LIGHTGBM_TPU_LOCKSAN=1 BENCH_ONLINE_OUT=bench_online_measured.json python scripts/bench_online.py || exit 1
 # chaos drill: serve+online loop under deterministic injected faults
 # (replica outage -> breaker -> half-open readmit, daemon crash
 # mid-publish -> intent adopt, torn model file -> registry survives),
 # gated on bitwise answers, recovery, and 0 request-path compiles /
 # 0 retraces / 0 implicit transfers — refreshes the committed artifact
-stage bench_chaos env BENCH_SANITIZE=1 BENCH_CHAOS_OUT=bench_chaos_measured.json python scripts/bench_chaos.py || exit 1
+stage bench_chaos env BENCH_SANITIZE=1 LIGHTGBM_TPU_LOCKSAN=1 BENCH_CHAOS_OUT=bench_chaos_measured.json python scripts/bench_chaos.py || exit 1
 # router tier: sustained-QPS overhead of the routing hop vs direct
 # backend access (<5% p99 inflation gate) + the chaos drill one level
 # up — backend killed mid-load, zero failed client requests, breaker
 # opens, restart readmits — refreshes the committed artifact
-stage bench_router env BENCH_SANITIZE=1 BENCH_ROUTER_OUT=bench_router_measured.json python scripts/bench_router.py || exit 1
+stage bench_router env BENCH_SANITIZE=1 LIGHTGBM_TPU_LOCKSAN=1 BENCH_ROUTER_OUT=bench_router_measured.json python scripts/bench_router.py || exit 1
 # streamed-vs-monolithic ingestion: peak RSS bounded by stream_chunk_rows
 # (not N), streamed store bitwise == batch within the sample budget,
 # streamed-store training sanitized at 0 retraces / 0 implicit transfers

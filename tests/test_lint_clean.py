@@ -1,8 +1,11 @@
 """Tier-1 guard: the whole package is graftlint-clean (mirrors
 tests/test_config_coverage.py — the codified-invariant pattern).  A
 hot-path hazard (implicit transfer, retrace, f64 drift, trace-time
-nondeterminism) introduced anywhere in lightgbm_tpu/ fails HERE, in CI,
-instead of in the next on-chip bench window."""
+nondeterminism) OR a thread-safety hazard (unguarded shared state,
+lock-order cycle, blocking under a lock, Condition misuse) introduced
+anywhere in lightgbm_tpu/ fails HERE, in CI, instead of in the next
+on-chip bench window."""
+import json
 import os
 import subprocess
 import sys
@@ -20,6 +23,22 @@ def test_package_is_lint_clean():
         capture_output=True, text=True, timeout=120)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "graftlint OK" in r.stdout
+
+
+def test_threadlint_rules_ran_and_are_clean():
+    """The clean verdict above must INCLUDE the threadlint family — a
+    rule-selected run over just those rules is clean, and the merged
+    --json schema carries them (empty findings, ok: true)."""
+    rules = ("unguarded-shared-state", "lock-order-cycle",
+             "blocking-under-lock", "condition-misuse")
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "run_lint.py"),
+         "--json", "--rules", ",".join(rules)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    payload = json.loads(r.stdout)
+    assert payload["ok"] is True
+    assert payload["findings"] == []
 
 
 def test_every_suppression_carries_a_reason():

@@ -312,6 +312,35 @@ def test_batcher_continuous_workers(binary_model):
         mb.close()
 
 
+def test_batcher_flush_counter_exact_under_concurrent_workers():
+    """Regression for the `batches_flushed` data race: with workers > 1
+    the read-modify-write ran unlocked and concurrent flushers could
+    lose increments.  The thread-safe profiling counter `serve.batches`
+    bumps once per flush on the same code paths, so after a storm of
+    single-request flushes the two tallies must agree EXACTLY (the
+    tier-1 threadlint gate pins the guard itself staying in place)."""
+
+    class TinyRuntime:
+        generation = 1
+
+        def predict(self, Xq, kind="value"):
+            time.sleep(0.001)            # widen the race window
+            return np.zeros(Xq.shape[0])
+
+    mb = MicroBatcher(TinyRuntime(), max_batch_rows=1,
+                      flush_deadline_ms=1, workers=4)
+    base = profiling.counter_value("serve.batches")
+    try:
+        futs = [mb.submit(np.zeros((1, 4))) for _ in range(200)]
+        for f in futs:
+            f.result(timeout=60)
+    finally:
+        mb.close()
+    flushed = profiling.counter_value("serve.batches") - base
+    assert flushed >= 1
+    assert mb.batches_flushed == flushed
+
+
 def test_batcher_admission_control(binary_model):
     """Beyond max_pending_rows the batcher sheds load with
     ServerOverloadedError instead of queueing without bound."""
